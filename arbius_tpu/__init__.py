@@ -1,0 +1,23 @@
+"""arbius_tpu — a TPU-native proof-of-AI-compute mining framework.
+
+A ground-up reimplementation of the capabilities of mainnet-pat/arbius
+(see SURVEY.md) designed for TPU hardware: template-declared models run as
+jit-compiled JAX/XLA graphs sharded over a device mesh, while the
+deterministic output-hashing / IPFS CID path stays exact for on-chain
+solution commitment.
+
+Layers (mirroring SURVEY.md §1 with L2 collapsed into the node process):
+  l0/         deterministic primitives: CIDv0 DAG hashing, keccak, seeds
+  templates/  model template schema engine (hydration, filters)
+  models/     JAX/Flax model zoo (SD-1.5, Kandinsky2, UNet3D video, RVM)
+  schedulers/ deterministic diffusion samplers (DDIM, DPM++, Euler[a], PNDM, LMS)
+  ops/        pallas TPU kernels for profiled hot spots
+  parallel/   mesh / sharding / collective layout (dp, tp, sp over ICI)
+  runtime/    in-process inference worker: compile cache, batching
+  codecs/     deterministic PNG / MP4 encoders (our determinism class)
+  node/       miner node: events, job queue, solver pipeline, stake mgmt
+  chain/      Arbitrum JSON-RPC adapter + in-process fake EngineV1
+  cli/        operator tooling
+"""
+
+__version__ = "0.1.0"

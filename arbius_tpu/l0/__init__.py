@@ -1,0 +1,44 @@
+"""L0 — shared deterministic primitives (SURVEY.md layer map L0).
+
+Pure, dependency-free building blocks every other layer trusts: CIDv0/UnixFS
+hashing, keccak commitments, ABI encoding, base58, seed derivation.
+"""
+from arbius_tpu.l0.base58 import b58decode, b58encode, cid_to_hex, hex_to_cid
+from arbius_tpu.l0.cid import (
+    cid_base58,
+    cid_hex,
+    cid_of_solution_files,
+    cid_onchain,
+    cidv0,
+    dag_of_directory,
+    dag_of_file,
+)
+from arbius_tpu.l0.commitment import (
+    SEED_MODULUS,
+    generate_commitment,
+    generate_commitment_hex,
+    taskid2seed,
+)
+from arbius_tpu.l0.keccak import keccak256, keccak256_hex
+from arbius_tpu.l0.abi import abi_encode
+
+__all__ = [
+    "abi_encode",
+    "b58decode",
+    "b58encode",
+    "cid_base58",
+    "cid_hex",
+    "cid_of_solution_files",
+    "cid_onchain",
+    "cid_to_hex",
+    "cidv0",
+    "dag_of_directory",
+    "dag_of_file",
+    "generate_commitment",
+    "generate_commitment_hex",
+    "hex_to_cid",
+    "keccak256",
+    "keccak256_hex",
+    "SEED_MODULUS",
+    "taskid2seed",
+]

@@ -1,0 +1,89 @@
+"""Minimal Solidity ABI encoder — just the types the protocol hashes use.
+
+The reference computes ids/commitments with ethers' defaultAbiCoder
+(`miner/src/utils.ts:42-49`) matching on-chain abi.encode
+(`contract/contracts/EngineV1.sol:431-438` hashTask, :418-425 hashModel,
+:537-543 generateCommitment). Supported types: address, bytes32, uint256,
+bytes, string. All values are encoded per the standard head/tail layout.
+"""
+from __future__ import annotations
+
+
+def _pad32(b: bytes, left: bool = True) -> bytes:
+    if len(b) > 32:
+        raise ValueError("value longer than 32 bytes")
+    pad = b"\x00" * (32 - len(b))
+    return pad + b if left else b + pad
+
+
+def _enc_static(typ: str, value) -> bytes:
+    if typ == "address":
+        if isinstance(value, str):
+            v = bytes.fromhex(value[2:] if value.startswith("0x") else value)
+        else:
+            v = bytes(value)
+        if len(v) != 20:
+            raise ValueError("address must be 20 bytes")
+        return _pad32(v)
+    if typ == "bytes32":
+        if isinstance(value, str):
+            v = bytes.fromhex(value[2:] if value.startswith("0x") else value)
+        else:
+            v = bytes(value)
+        if len(v) != 32:
+            raise ValueError("bytes32 must be 32 bytes")
+        return v
+    if typ in ("uint256", "uint64", "uint8", "uint"):
+        v = int(value)
+        bits = 256 if typ == "uint" else int(typ[4:])
+        if not 0 <= v < (1 << bits):
+            raise ValueError(f"value {v} out of range for {typ}")
+        return v.to_bytes(32, "big")
+    raise ValueError(f"unsupported static type {typ}")
+
+
+def _enc_dynamic(typ: str, value) -> bytes:
+    # Dispatch on the DECLARED type, matching ethers defaultAbiCoder:
+    # "string" is always utf-8 text (even if it looks like hex);
+    # "bytes" takes raw bytes or a 0x-hex string, nothing else.
+    if typ == "string":
+        if not isinstance(value, str):
+            raise ValueError("string value must be str")
+        v = value.encode("utf-8")
+    else:  # bytes
+        if isinstance(value, str):
+            if not value.startswith("0x"):
+                raise ValueError("bytes value must be raw bytes or 0x-hex string")
+            v = bytes.fromhex(value[2:])
+        else:
+            v = bytes(value)
+    padded_len = (len(v) + 31) // 32 * 32
+    return int(len(v)).to_bytes(32, "big") + v + b"\x00" * (padded_len - len(v))
+
+
+_DYNAMIC = ("bytes", "string")
+
+
+def abi_encode(types: list[str], values: list) -> bytes:
+    """abi.encode(...) — standard (non-packed) encoding."""
+    if len(types) != len(values):
+        raise ValueError("types/values length mismatch")
+    head = []
+    tail = []
+    head_size = 32 * len(types)
+    for typ, val in zip(types, values):
+        if typ in _DYNAMIC:
+            head.append(None)  # patched below
+            tail.append(_enc_dynamic(typ, val))
+        else:
+            head.append(_enc_static(typ, val))
+            tail.append(b"")
+    out_head = []
+    offset = head_size
+    for h, t in zip(head, tail):
+        if h is None:
+            out_head.append(int(offset).to_bytes(32, "big"))
+            offset += len(t)
+        else:
+            out_head.append(h)
+    return b"".join(out_head) + b"".join(tail)

@@ -1,0 +1,168 @@
+"""IPFS CIDv0 computation — the deterministic artifact kernel (L0).
+
+Three byte-compatible implementations exist in the reference and must agree:
+on-chain Solidity (`contract/contracts/libraries/IPFS.sol:38-67`), the IPFS
+daemon the miner pins through (`miner/src/ipfs.ts:11-16` — cidVersion 0,
+sha2-256, chunker size-262144, rawLeaves false, wrapWithDirectory true), and
+the website's base58<->hex converter. This module implements all of it
+standalone, so the TPU node never needs an IPFS daemon to know a CID before
+pinning.
+
+Layout notes (dag-pb / UnixFS):
+  PBNode      { Links: repeated field 2 (PBLink), Data: field 1 (bytes) }
+              — canonical dag-pb serialization writes Links BEFORE Data.
+  PBLink      { Hash: field 1 (bytes), Name: field 2 (string, always
+              emitted, may be empty), Tsize: field 3 (varint) }
+  UnixFS Data { Type: field 1 varint (1=Directory, 2=File),
+              Data: field 2 (bytes, omitted when empty),
+              filesize: field 3 varint,
+              blocksizes: repeated field 4 varint }
+
+A CIDv0 is the 34-byte multihash 0x1220 || sha256(block).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from arbius_tpu.l0.varint import encode_varint
+from arbius_tpu.l0.base58 import b58encode
+
+CHUNK_SIZE = 262144            # miner/src/ipfs.ts:14 "size-262144"
+MAX_LINKS_PER_BLOCK = 174      # go-ipfs balanced DAG builder default width
+ONCHAIN_MAX_CONTENT = 65536    # libraries/IPFS.sol:39
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def cidv0(block: bytes) -> bytes:
+    """34-byte multihash (0x1220 prefix) of a serialized dag-pb block."""
+    return b"\x12\x20" + sha256(block)
+
+
+def _lenprefixed(field_tag: bytes, payload: bytes) -> bytes:
+    return field_tag + encode_varint(len(payload)) + payload
+
+
+def unixfs_file_leaf(content: bytes) -> bytes:
+    """Serialized PBNode for a single UnixFS file chunk (rawLeaves=false).
+
+    Matches the on-chain encoder byte-for-byte for non-empty content
+    (`libraries/IPFS.sol:42-64`): Data = 0802 | 12 <len> content | 18 <len>,
+    wrapped in PBNode field 1.
+    """
+    unixfs = b"\x08\x02"
+    if content:
+        unixfs += _lenprefixed(b"\x12", content)
+    unixfs += b"\x18" + encode_varint(len(content))
+    return _lenprefixed(b"\x0a", unixfs)
+
+
+def cid_onchain(content: bytes) -> bytes:
+    """Exact mirror of Solidity getIPFSCID (`libraries/IPFS.sol:38-67`).
+
+    Note the contract always emits the UnixFS Data field, even when content
+    is empty — go-ipfs omits it for empty files. Mirror the contract here,
+    including its 65536-byte cap.
+    """
+    if len(content) > ONCHAIN_MAX_CONTENT:
+        raise ValueError("Max content size is 65536 bytes")
+    lv = encode_varint(len(content))
+    meat = b"\x08\x02\x12" + lv + content + b"\x18" + lv
+    return cidv0(b"\x0a" + encode_varint(len(meat)) + meat)
+
+
+@dataclass(frozen=True)
+class DagNode:
+    """A computed dag-pb node: its CID and the sizes needed by parents."""
+    cid: bytes          # 34-byte multihash
+    block_size: int     # serialized block length
+    tsize: int          # cumulative dag size (block + all descendants)
+    content_size: int   # UnixFS file/dir logical content bytes
+
+
+def _pblink(child: DagNode, name: str) -> bytes:
+    link = _lenprefixed(b"\x0a", child.cid)
+    link += _lenprefixed(b"\x12", name.encode("utf-8"))
+    link += b"\x18" + encode_varint(child.tsize)
+    return _lenprefixed(b"\x12", link)
+
+
+def _file_parent(children: list[DagNode]) -> DagNode:
+    """Internal balanced-DAG node over file chunks/subtrees."""
+    filesize = sum(c.content_size for c in children)
+    links = b"".join(_pblink(c, "") for c in children)
+    unixfs = b"\x08\x02" + b"\x18" + encode_varint(filesize)
+    unixfs += b"".join(b"\x20" + encode_varint(c.content_size) for c in children)
+    block = links + _lenprefixed(b"\x0a", unixfs)
+    tsize = len(block) + sum(c.tsize for c in children)
+    return DagNode(cidv0(block), len(block), tsize, filesize)
+
+
+def dag_of_file(content: bytes) -> DagNode:
+    """Balanced UnixFS DAG for arbitrary-size content (daemon settings).
+
+    size-262144 chunker, rawLeaves=false, width-174 balanced layout — the
+    exact profile in `miner/src/ipfs.ts:11-16`, so CIDs match what the
+    reference miner's daemon would return for the same bytes.
+    """
+    chunks = [content[i:i + CHUNK_SIZE] for i in range(0, len(content), CHUNK_SIZE)]
+    if not chunks:
+        chunks = [b""]
+    level: list[DagNode] = []
+    for ch in chunks:
+        block = unixfs_file_leaf(ch)
+        level.append(DagNode(cidv0(block), len(block), len(block), len(ch)))
+    if len(level) == 1:
+        return level[0]
+    while len(level) > 1:
+        level = [
+            _file_parent(level[i:i + MAX_LINKS_PER_BLOCK])
+            for i in range(0, len(level), MAX_LINKS_PER_BLOCK)
+        ]
+    return level[0]
+
+
+def dag_of_directory(entries: dict[str, bytes]) -> DagNode:
+    """UnixFS directory over named files, links sorted by name (go-ipfs).
+
+    This is the wrapWithDirectory=true root the miner submits as the
+    solution CID (`miner/src/ipfs.ts:42-47` extracts the wrapping root).
+    """
+    for name in entries:
+        if "/" in name:
+            # the daemon would treat this as a nested path, not a flat name
+            raise ValueError(f"directory entry name may not contain '/': {name!r}")
+    children = {name: dag_of_file(data) for name, data in entries.items()}
+    links = b"".join(_pblink(children[name], name) for name in sorted(children))
+    unixfs = b"\x08\x01"
+    block = links + _lenprefixed(b"\x0a", unixfs)
+    if len(block) > CHUNK_SIZE:
+        # kubo auto-shards (HAMT) directories whose block exceeds 256 KiB;
+        # we don't implement HAMT sharding, so refuse rather than silently
+        # diverge from daemon parity. Model outputs are a handful of files.
+        raise NotImplementedError(
+            "directory block exceeds 256 KiB; HAMT sharding not implemented")
+    tsize = len(block) + sum(c.tsize for c in children.values())
+    dirsize = sum(c.content_size for c in children.values())
+    return DagNode(cidv0(block), len(block), tsize, dirsize)
+
+
+def cid_of_solution_files(files: dict[str, bytes]) -> bytes:
+    """Solution CID for a set of output files: dir-wrapped root multihash.
+
+    Equivalent to the reference path pinFilesToIPFS -> base58 -> hex
+    (`miner/src/ipfs.ts:28-76`, `miner/src/models.ts:34-54`) but computed
+    locally and deterministically.
+    """
+    return dag_of_directory(files).cid
+
+
+def cid_hex(cid: bytes) -> str:
+    return "0x" + cid.hex()
+
+
+def cid_base58(cid: bytes) -> str:
+    return b58encode(cid)

@@ -1,0 +1,100 @@
+"""Keccak-256 (the pre-NIST padding variant used by Ethereum).
+
+Python's hashlib only ships SHA-3 (NIST padding 0x06); Ethereum uses the
+original Keccak padding 0x01, so we implement keccak-f[1600] here. A C
+implementation lives in ``arbius_tpu/native`` and is used when the shared
+library is built; this module is the always-available fallback and the
+reference for its tests.
+
+Parity target: ethers.utils.keccak256 as used for solution commitments
+(reference `miner/src/utils.ts:42-49`) and every on-chain id hash
+(`contract/contracts/EngineV1.sol:431-438`, :537-543).
+"""
+from __future__ import annotations
+
+_ROUND_CONSTANTS = (
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A, 0x8000000080008000,
+    0x000000000000808B, 0x0000000080000001, 0x8000000080008081, 0x8000000000008009,
+    0x000000000000008A, 0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089, 0x8000000000008003,
+    0x8000000000008002, 0x8000000000000080, 0x000000000000800A, 0x800000008000000A,
+    0x8000000080008081, 0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+)
+
+_ROTATIONS = (
+    (0, 36, 3, 41, 18),
+    (1, 44, 10, 45, 2),
+    (62, 6, 43, 15, 61),
+    (28, 55, 25, 21, 56),
+    (27, 20, 39, 8, 14),
+)
+
+_MASK = (1 << 64) - 1
+
+
+def _rol(x: int, n: int) -> int:
+    n %= 64
+    return ((x << n) | (x >> (64 - n))) & _MASK
+
+
+def _keccak_f(state: list[int]) -> None:
+    for rc in _ROUND_CONSTANTS:
+        # theta
+        c = [state[x] ^ state[x + 5] ^ state[x + 10] ^ state[x + 15] ^ state[x + 20]
+             for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rol(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                state[x + 5 * y] ^= d[x]
+        # rho + pi
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rol(state[x + 5 * y], _ROTATIONS[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                state[x + 5 * y] = b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y] & _MASK)
+        # iota
+        state[0] ^= rc
+
+
+def _keccak256_py(data: bytes) -> bytes:
+    rate = 136  # (1600 - 2*256) / 8
+    state = [0] * 25
+    # absorb with keccak padding 0x01 ... 0x80
+    padded = data + b"\x01" + b"\x00" * ((-len(data) - 2) % rate) + b"\x80"
+    for block_start in range(0, len(padded), rate):
+        block = padded[block_start:block_start + rate]
+        for i in range(rate // 8):
+            state[i] ^= int.from_bytes(block[8 * i:8 * i + 8], "little")
+        _keccak_f(state)
+    return b"".join(state[i].to_bytes(8, "little") for i in range(4))
+
+
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is None:
+        try:
+            from arbius_tpu.native import lib as _lib
+            _native = _lib if _lib is not None and hasattr(_lib, "arb_keccak256") else False
+        except Exception:
+            _native = False
+    return _native
+
+
+def keccak256(data: bytes) -> bytes:
+    native = _load_native()
+    if native:
+        import ctypes
+        out = ctypes.create_string_buffer(32)
+        native.arb_keccak256(data, len(data), out)
+        return out.raw
+    return _keccak256_py(data)
+
+
+def keccak256_hex(data: bytes) -> str:
+    return "0x" + keccak256(data).hex()
