@@ -17,22 +17,18 @@ def _pad32(b: bytes, left: bool = True) -> bytes:
 
 
 def _enc_static(typ: str, value) -> bytes:
-    if typ == "address":
+    if typ in ("address", "bytes32"):
         if isinstance(value, str):
             v = bytes.fromhex(value[2:] if value.startswith("0x") else value)
-        else:
+        elif isinstance(value, (bytes, bytearray)):
             v = bytes(value)
-        if len(v) != 20:
-            raise ValueError("address must be 20 bytes")
-        return _pad32(v)
-    if typ == "bytes32":
-        if isinstance(value, str):
-            v = bytes.fromhex(value[2:] if value.startswith("0x") else value)
         else:
-            v = bytes(value)
-        if len(v) != 32:
-            raise ValueError("bytes32 must be 32 bytes")
-        return v
+            # bytes(int) would silently yield N zero bytes — make it loud
+            raise ValueError(f"{typ} value must be hex string or bytes, got {type(value).__name__}")
+        want = 20 if typ == "address" else 32
+        if len(v) != want:
+            raise ValueError(f"{typ} must be {want} bytes")
+        return _pad32(v) if typ == "address" else v
     if typ in ("uint256", "uint64", "uint8", "uint"):
         v = int(value)
         bits = 256 if typ == "uint" else int(typ[4:])

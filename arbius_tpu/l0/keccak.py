@@ -62,8 +62,13 @@ def _keccak_f(state: list[int]) -> None:
 def _keccak256_py(data: bytes) -> bytes:
     rate = 136  # (1600 - 2*256) / 8
     state = [0] * 25
-    # absorb with keccak padding 0x01 ... 0x80
-    padded = data + b"\x01" + b"\x00" * ((-len(data) - 2) % rate) + b"\x80"
+    # absorb with keccak pad10*1: when exactly one pad byte fits, the 0x01
+    # domain bit and the final 0x80 bit merge into a single 0x81 byte
+    pad_len = rate - (len(data) % rate)
+    if pad_len == 1:
+        padded = data + b"\x81"
+    else:
+        padded = data + b"\x01" + b"\x00" * (pad_len - 2) + b"\x80"
     for block_start in range(0, len(padded), rate):
         block = padded[block_start:block_start + rate]
         for i in range(rate // 8):

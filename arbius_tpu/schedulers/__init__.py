@@ -1,0 +1,14 @@
+"""Deterministic diffusion samplers (template enum `scheduler`)."""
+from arbius_tpu.schedulers.diffusion import (
+    NUM_TRAIN_TIMESTEPS,
+    alphas_cumprod,
+)
+from arbius_tpu.schedulers.samplers import SAMPLER_NAMES, Sampler, get_sampler
+
+__all__ = [
+    "NUM_TRAIN_TIMESTEPS",
+    "SAMPLER_NAMES",
+    "Sampler",
+    "alphas_cumprod",
+    "get_sampler",
+]

@@ -1,0 +1,31 @@
+"""Template/schema engine (L0b) — model templates drive everything.
+
+A template declares a model's input schema, output files, and metadata
+(documented in the reference at `docs/src/pages/register-model.mdx:63-120`).
+The five reference templates ship as data files under ``data/``.
+"""
+from arbius_tpu.templates.engine import (
+    FilterResult,
+    HydrationError,
+    InputField,
+    MiningFilter,
+    OutputField,
+    Template,
+    check_model_filter,
+    hydrate_input,
+    load_template,
+    template_names,
+)
+
+__all__ = [
+    "FilterResult",
+    "HydrationError",
+    "InputField",
+    "MiningFilter",
+    "OutputField",
+    "Template",
+    "check_model_filter",
+    "hydrate_input",
+    "load_template",
+    "template_names",
+]
