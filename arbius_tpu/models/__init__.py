@@ -1,0 +1,1 @@
+"""JAX/Flax model zoo — one family per reference template class."""

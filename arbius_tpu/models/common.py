@@ -1,0 +1,192 @@
+"""Shared neural building blocks for the diffusion model zoo.
+
+TPU-first design notes:
+  - NHWC activation layout throughout (XLA's native conv layout on TPU —
+    keeps the MXU fed without transposes).
+  - bfloat16 compute / float32 params by default: matmuls and convs hit the
+    MXU in bf16; GroupNorm/softmax statistics are computed in float32 for
+    numerical stability and cross-run determinism.
+  - No data-dependent Python control flow — everything jit/scan friendly.
+
+Architecture parity targets (what the reference's model class requires, per
+SURVEY.md §2.3): SD-1.5-family UNet2D + VAE + CLIP text encoder
+(templates/anythingv3.json), Kandinsky prior+decoder, UNet3D video models,
+RVM ConvGRU. The blocks here are the common substrate.
+"""
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sinusoidal_embedding(t: jax.Array, dim: int, max_period: float = 10000.0,
+                         flip: bool = True) -> jax.Array:
+    """Transformer-style timestep embedding; [B] -> [B, dim] float32."""
+    half = dim // 2
+    freqs = jnp.exp(-np.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    sin, cos = jnp.sin(args), jnp.cos(args)
+    emb = jnp.concatenate([cos, sin] if flip else [sin, cos], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+class GroupNorm32(nn.Module):
+    """GroupNorm computed in float32 regardless of activation dtype."""
+    num_groups: int = 32
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        orig = x.dtype
+        groups = math.gcd(x.shape[-1], self.num_groups)
+        x = nn.GroupNorm(num_groups=groups, epsilon=self.epsilon,
+                         dtype=jnp.float32, param_dtype=jnp.float32)(
+            x.astype(jnp.float32))
+        return x.astype(orig)
+
+
+class TimestepEmbedding(nn.Module):
+    """MLP lift of the sinusoidal embedding: dim -> 4*dim typically."""
+    out_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, emb):
+        emb = nn.Dense(self.out_dim, dtype=self.dtype)(emb.astype(self.dtype))
+        emb = nn.silu(emb)
+        return nn.Dense(self.out_dim, dtype=self.dtype)(emb)
+
+
+class ResnetBlock(nn.Module):
+    """GN-SiLU-conv ×2 with timestep conditioning and learned skip."""
+    out_channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, temb=None):
+        h = GroupNorm32()(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype)(h)
+        if temb is not None:
+            t = nn.Dense(self.out_channels, dtype=self.dtype)(nn.silu(temb))
+            h = h + t[:, None, None, :]
+        h = GroupNorm32()(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype)(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
+                        name="skip_proj")(x)
+        return x + h
+
+
+class Attention(nn.Module):
+    """Multi-head attention; self- or cross- depending on `context`.
+
+    Softmax in float32. Uses jnp.einsum so XLA fuses QK^T/softmax/V on the
+    MXU; a pallas flash kernel can swap in behind the same interface for
+    long sequences (see arbius_tpu/ops).
+    """
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, context=None, mask=None):
+        ctx = x if context is None else context
+        inner = self.num_heads * self.head_dim
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
+
+        def split(t):  # [B, S, inner] -> [B, H, S, D]
+            b, s, _ = t.shape
+            return t.reshape(b, s, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = split(q), split(k), split(v)
+        scale = 1.0 / np.sqrt(self.head_dim)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+        if mask is not None:
+            logits = logits + mask
+        probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        b, h, s, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        return nn.Dense(inner, dtype=self.dtype, name="to_out")(out)
+
+
+class GEGLU(nn.Module):
+    dim_out: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.dim_out * 2, dtype=self.dtype)(x)
+        h, gate = jnp.split(h, 2, axis=-1)
+        return h * nn.gelu(gate)
+
+
+class TransformerBlock(nn.Module):
+    """LN->self-attn, LN->cross-attn, LN->GEGLU-FF, all residual."""
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, context=None):
+        x = x + Attention(self.num_heads, self.head_dim, self.dtype, name="attn1")(
+            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype))
+        x = x + Attention(self.num_heads, self.head_dim, self.dtype, name="attn2")(
+            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype), context=context)
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        h = GEGLU(x.shape[-1] * 4, self.dtype)(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype)(h)
+        return x + h
+
+
+class SpatialTransformer(nn.Module):
+    """Transformer over flattened H*W tokens with 1x1 in/out projections."""
+    num_heads: int
+    head_dim: int
+    depth: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, context=None):
+        b, h, w, c = x.shape
+        residual = x
+        x = GroupNorm32()(x)
+        x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_in")(x)
+        x = x.reshape(b, h * w, c)
+        for i in range(self.depth):
+            x = TransformerBlock(self.num_heads, self.head_dim, self.dtype,
+                                 name=f"block_{i}")(x, context)
+        x = x.reshape(b, h, w, c)
+        x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_out")(x)
+        return x + residual
+
+
+class Downsample(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(self.channels, (3, 3), strides=(2, 2), padding=1,
+                       dtype=self.dtype)(x)
+
+
+class Upsample(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        x = jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+        return nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype)(x)

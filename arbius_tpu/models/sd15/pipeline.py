@@ -1,0 +1,173 @@
+"""SD-1.5 txt2img pipeline — the anythingv3 execution path, in-process.
+
+Replaces the reference's HTTP hop to a cog container
+(`miner/src/index.ts:852-876`) with a jit-compiled XLA program per shape
+bucket. Determinism root: the per-task seed (taskid2seed) feeds a JAX PRNG
+key; init latents and every ancestral noise draw derive from it via fold_in,
+so a task id always produces the same bytes on the same model build.
+
+Batching: `generate` takes a batch of tasks sharing one shape bucket
+(width, height, steps, scheduler are the bucket key; the template enums make
+this a small finite set). Per-sample guidance scales and seeds vary freely
+within a batch. The runtime layer (arbius_tpu/runtime) groups queued tasks
+into buckets and shards the batch axis over the device mesh.
+"""
+from __future__ import annotations
+
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arbius_tpu.models.sd15.text_encoder import TextEncoder, TextEncoderConfig
+from arbius_tpu.models.sd15.tokenizer import ByteTokenizer
+from arbius_tpu.models.sd15.unet import UNet2DCondition, UNetConfig
+from arbius_tpu.models.sd15.vae import (
+    SD_LATENT_SCALE,
+    VAEConfig,
+    VAEDecoder,
+    decode_to_images,
+)
+from arbius_tpu.schedulers import get_sampler
+
+
+@dataclass(frozen=True)
+class SD15Config:
+    unet: UNetConfig = UNetConfig()
+    vae: VAEConfig = VAEConfig()
+    text: TextEncoderConfig = TextEncoderConfig()
+
+    @classmethod
+    def tiny(cls) -> "SD15Config":
+        return cls(UNetConfig.tiny(), VAEConfig.tiny(), TextEncoderConfig.tiny())
+
+
+class SD15Pipeline:
+    """Stateless module bundle + jitted per-bucket executables."""
+
+    VAE_FACTOR = 8
+
+    def __init__(self, config: SD15Config | None = None, tokenizer=None):
+        self.config = config or SD15Config()
+        if self.config.text.width != self.config.unet.context_dim:
+            raise ValueError(
+                f"text encoder width ({self.config.text.width}) must equal "
+                f"unet context_dim ({self.config.unet.context_dim})")
+        self.tokenizer = tokenizer or ByteTokenizer(
+            max_length=self.config.text.max_length)
+        self.unet = UNet2DCondition(self.config.unet)
+        self.vae = VAEDecoder(self.config.vae)
+        self.text_encoder = TextEncoder(self.config.text)
+        # per-instance executable cache: dies with the pipeline (an lru_cache
+        # on the method would pin self in a class-global cache)
+        self._buckets: dict[tuple, object] = {}
+
+    # -- params ----------------------------------------------------------
+    def init_params(self, seed: int = 0, height: int = 64, width: int = 64) -> dict:
+        """Deterministic parameter init (stands in for converted weights)."""
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
+        latents = jnp.zeros((1, lh, lw, self.config.unet.in_channels))
+        ids = jnp.zeros((1, self.config.text.max_length), jnp.int32)
+        ctx = jnp.zeros((1, self.config.text.max_length, self.config.unet.context_dim))
+        return {
+            "unet": self.unet.init(k1, latents, jnp.zeros((1,)), ctx)["params"],
+            "vae": self.vae.init(k2, latents)["params"],
+            "text": self.text_encoder.init(k3, ids)["params"],
+        }
+
+    # -- compiled bucket -------------------------------------------------
+    def _bucket_fn(self, batch: int, height: int, width: int,
+                   steps: int, scheduler: str):
+        key = (batch, height, width, steps, scheduler)
+        cached = self._buckets.get(key)
+        if cached is not None:
+            return cached
+        sampler = get_sampler(scheduler, steps)
+        lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
+        lat_shape = (batch, lh, lw, self.config.unet.in_channels)
+
+        def run(params, ids_cond, ids_uncond, guidance, seeds_lo, seeds_hi):
+            ctx_c = self.text_encoder.apply({"params": params["text"]}, ids_cond)
+            ctx_u = self.text_encoder.apply({"params": params["text"]}, ids_uncond)
+            context = jnp.concatenate([ctx_u, ctx_c], axis=0)  # [2B, L, D]
+
+            # full 53-bit taskid2seed space: low word keys, high word folded in
+            keys = jax.vmap(
+                lambda lo, hi: jax.random.fold_in(jax.random.PRNGKey(lo), hi)
+            )(seeds_lo, seeds_hi)
+            x = jax.vmap(
+                lambda k: jax.random.normal(k, lat_shape[1:], jnp.float32))(keys)
+            x = x * sampler.init_noise_sigma
+            g = guidance.astype(jnp.float32)[:, None, None, None]
+
+            def body(carry, i):
+                x, state = carry
+                xin = jnp.concatenate([x, x], axis=0) * sampler.input_scale[i]
+                t = jnp.full((2 * batch,), sampler.timesteps[i])
+                eps = self.unet.apply({"params": params["unet"]}, xin, t, context)
+                eps_u, eps_c = jnp.split(eps.astype(jnp.float32), 2, axis=0)
+                eps = eps_u + g * (eps_c - eps_u)
+                noise = jax.vmap(lambda k: jax.random.normal(
+                    jax.random.fold_in(k, i), lat_shape[1:], jnp.float32))(keys)
+                x, state = sampler.step(i, x, eps, state, noise)
+                return (x, state), None
+
+            (x, _), _ = jax.lax.scan(
+                body, (x, sampler.init_carry(x)),
+                jnp.arange(sampler.num_model_calls))
+            pixels = self.vae.apply({"params": params["vae"]}, x / SD_LATENT_SCALE)
+            return decode_to_images(pixels)
+
+        fn = jax.jit(run)
+        self._buckets[key] = fn
+        return fn
+
+    # -- public API ------------------------------------------------------
+    def generate(
+        self,
+        params: dict,
+        prompts: list[str],
+        negative_prompts: list[str],
+        seeds: list[int],
+        *,
+        width: int = 512,
+        height: int = 512,
+        num_inference_steps: int = 20,
+        guidance_scale: float | list[float] = 7.5,
+        scheduler: str = "DDIM",
+    ) -> np.ndarray:
+        """Run a shape bucket; returns uint8 images [B, H, W, 3]."""
+        batch = len(prompts)
+        if len(negative_prompts) != batch or len(seeds) != batch:
+            raise ValueError("prompts/negative_prompts/seeds must align")
+        # latents must survive the UNet's downsample pyramid and re-align
+        # with every skip connection on the way up
+        levels = len(self.config.unet.block_channels)
+        granule = self.VAE_FACTOR * (2 ** (levels - 1))
+        if height % granule or width % granule:
+            raise ValueError(f"height/width must be multiples of {granule}")
+        g = list(guidance_scale) if isinstance(guidance_scale, (list, tuple)) \
+            else [guidance_scale] * batch
+        if len(g) != batch:
+            raise ValueError("guidance_scale list must align with prompts")
+        fn = self._bucket_fn(batch, height, width, num_inference_steps, scheduler)
+        ids_c = self.tokenizer.encode_batch(prompts)
+        ids_u = self.tokenizer.encode_batch(negative_prompts)
+        vocab = self.config.text.vocab_size
+        if int(ids_c.max()) >= vocab or int(ids_u.max()) >= vocab:
+            raise ValueError(
+                f"tokenizer produced id >= vocab_size ({vocab}); "
+                "tokenizer and text-encoder config are mismatched")
+        seeds_arr = np.asarray(seeds, dtype=np.uint64)
+        images = fn(
+            params,
+            jnp.asarray(ids_c),
+            jnp.asarray(ids_u),
+            jnp.asarray(g, jnp.float32),
+            jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
+            jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
+        )
+        return np.asarray(images)

@@ -1,0 +1,69 @@
+"""CLIP-style causal text transformer (SD-1.5's conditioning encoder).
+
+ViT-L/14 text tower topology: vocab 49408, 77 positions, width 768,
+12 layers, 12 heads, quick-gelu MLP, causal mask, final LayerNorm.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TextEncoderConfig:
+    vocab_size: int = 49408
+    max_length: int = 77
+    width: int = 768
+    layers: int = 12
+    heads: int = 12
+    dtype: str = "bfloat16"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def tiny(cls) -> "TextEncoderConfig":
+        return cls(vocab_size=512, max_length=16, width=16, layers=1, heads=2)
+
+
+def quick_gelu(x):
+    return x * nn.sigmoid(1.702 * x)
+
+
+class _EncoderLayer(nn.Module):
+    cfg: TextEncoderConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        dt = self.cfg.jdtype
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(dt)
+        h = nn.SelfAttention(num_heads=self.cfg.heads, dtype=dt,
+                             name="attn")(h, mask=mask)
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(dt)
+        h = nn.Dense(self.cfg.width * 4, dtype=dt)(h)
+        h = quick_gelu(h)
+        h = nn.Dense(self.cfg.width, dtype=dt)(h)
+        return x + h
+
+
+class TextEncoder(nn.Module):
+    """__call__(token_ids[B, L]) -> last hidden state [B, L, width]."""
+    config: TextEncoderConfig
+
+    @nn.compact
+    def __call__(self, ids):
+        cfg = self.config
+        dt = cfg.jdtype
+        tok = nn.Embed(cfg.vocab_size, cfg.width, dtype=dt, name="token_embed")(ids)
+        pos = self.param("pos_embed", nn.initializers.normal(0.01),
+                         (cfg.max_length, cfg.width))
+        x = tok + pos[None, : ids.shape[1]].astype(dt)
+        causal = nn.make_causal_mask(ids)
+        for i in range(cfg.layers):
+            x = _EncoderLayer(cfg, name=f"layer_{i}")(x, causal)
+        return nn.LayerNorm(dtype=jnp.float32, name="final_norm")(x)

@@ -1,0 +1,109 @@
+"""Conditional UNet2D — the SD-1.5 denoiser (anythingv3's model class).
+
+Reference capability target: the UNet the anythingv3 cog container runs
+(templates/anythingv3.json declares SD-1.5 txt2img semantics). Architecture
+follows the published SD-1.5 topology: 4-level encoder/decoder
+(320/640/1280/1280 channels, 2 resnets per level), spatial transformers with
+text cross-attention at the three highest resolutions, 1280-dim mid block.
+
+Built TPU-first: NHWC, bf16 on the MXU, static shapes per (H, W) bucket —
+the template's width/height enums form a small finite set, so every shape
+bucket is a separate cached XLA executable.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from arbius_tpu.models.common import (
+    Downsample,
+    GroupNorm32,
+    ResnetBlock,
+    SpatialTransformer,
+    TimestepEmbedding,
+    Upsample,
+    sinusoidal_embedding,
+)
+
+
+@dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_channels: tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    attention_levels: tuple[bool, ...] = (True, True, True, False)
+    num_heads: int = 8
+    context_dim: int = 768
+    transformer_depth: int = 1
+    dtype: str = "bfloat16"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def tiny(cls) -> "UNetConfig":
+        """Small config for tests: same topology, toy widths."""
+        return cls(block_channels=(8, 8, 8, 8), layers_per_block=1,
+                   num_heads=2, context_dim=16)
+
+
+class UNet2DCondition(nn.Module):
+    """epsilon-prediction UNet; __call__(latents[B,H,W,4], t[B], context[B,S,D])."""
+    config: UNetConfig
+
+    @nn.compact
+    def __call__(self, x, t, context):
+        cfg = self.config
+        dt = cfg.jdtype
+        x = x.astype(dt)
+        context = context.astype(dt)
+
+        temb = sinusoidal_embedding(t, cfg.block_channels[0])
+        temb = TimestepEmbedding(cfg.block_channels[0] * 4, dt)(temb)
+
+        h = nn.Conv(cfg.block_channels[0], (3, 3), padding=1, dtype=dt,
+                    name="conv_in")(x)
+        skips = [h]
+
+        # encoder
+        for level, ch in enumerate(cfg.block_channels):
+            for j in range(cfg.layers_per_block):
+                h = ResnetBlock(ch, dt, name=f"down_{level}_res_{j}")(h, temb)
+                if cfg.attention_levels[level]:
+                    h = SpatialTransformer(
+                        cfg.num_heads, ch // cfg.num_heads, cfg.transformer_depth,
+                        dt, name=f"down_{level}_attn_{j}")(h, context)
+                skips.append(h)
+            if level < len(cfg.block_channels) - 1:
+                h = Downsample(ch, dt, name=f"down_{level}_ds")(h)
+                skips.append(h)
+
+        # mid
+        mid_ch = cfg.block_channels[-1]
+        h = ResnetBlock(mid_ch, dt, name="mid_res_0")(h, temb)
+        h = SpatialTransformer(cfg.num_heads, mid_ch // cfg.num_heads,
+                               cfg.transformer_depth, dt, name="mid_attn")(h, context)
+        h = ResnetBlock(mid_ch, dt, name="mid_res_1")(h, temb)
+
+        # decoder
+        for level in reversed(range(len(cfg.block_channels))):
+            ch = cfg.block_channels[level]
+            for j in range(cfg.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = ResnetBlock(ch, dt, name=f"up_{level}_res_{j}")(h, temb)
+                if cfg.attention_levels[level]:
+                    h = SpatialTransformer(
+                        cfg.num_heads, ch // cfg.num_heads, cfg.transformer_depth,
+                        dt, name=f"up_{level}_attn_{j}")(h, context)
+            if level > 0:
+                h = Upsample(ch, dt, name=f"up_{level}_us")(h)
+
+        h = GroupNorm32(name="norm_out")(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.config.out_channels, (3, 3), padding=1,
+                    dtype=jnp.float32, name="conv_out")(h.astype(jnp.float32))
+        return h

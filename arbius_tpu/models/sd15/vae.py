@@ -1,0 +1,110 @@
+"""AutoencoderKL (the SD VAE) — latent <-> pixel codec.
+
+txt2img only needs the decoder on the hot path; the encoder ships too for
+img2img/file-input model classes (e.g. video matting preprocessing).
+Latent scaling factor 0.18215 (SD-1.5 convention).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from arbius_tpu.models.common import (
+    Attention,
+    Downsample,
+    GroupNorm32,
+    ResnetBlock,
+    Upsample,
+)
+
+SD_LATENT_SCALE = 0.18215
+
+
+@dataclass(frozen=True)
+class VAEConfig:
+    latent_channels: int = 4
+    block_channels: tuple[int, ...] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def tiny(cls) -> "VAEConfig":
+        return cls(block_channels=(8, 8, 8, 8), layers_per_block=1)
+
+
+class _MidAttention(nn.Module):
+    """Single-head full self-attention over the bottleneck spatial map."""
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        residual = x
+        x = GroupNorm32()(x)
+        x = x.reshape(b, h * w, c)
+        x = Attention(num_heads=1, head_dim=c, dtype=self.dtype)(x)
+        return residual + x.reshape(b, h, w, c)
+
+
+class VAEDecoder(nn.Module):
+    config: VAEConfig
+
+    @nn.compact
+    def __call__(self, z):
+        cfg = self.config
+        dt = cfg.jdtype
+        z = z.astype(dt)
+        z = nn.Conv(cfg.latent_channels, (1, 1), dtype=dt, name="post_quant")(z)
+        h = nn.Conv(cfg.block_channels[-1], (3, 3), padding=1, dtype=dt,
+                    name="conv_in")(z)
+        h = ResnetBlock(cfg.block_channels[-1], dt, name="mid_res_0")(h)
+        h = _MidAttention(dt, name="mid_attn")(h)
+        h = ResnetBlock(cfg.block_channels[-1], dt, name="mid_res_1")(h)
+        for level in reversed(range(len(cfg.block_channels))):
+            ch = cfg.block_channels[level]
+            for j in range(cfg.layers_per_block + 1):
+                h = ResnetBlock(ch, dt, name=f"up_{level}_res_{j}")(h)
+            if level > 0:
+                h = Upsample(ch, dt, name=f"up_{level}_us")(h)
+        h = GroupNorm32(name="norm_out")(h)
+        h = nn.silu(h)
+        # final conv in fp32: pixel values feed the deterministic PNG path
+        return nn.Conv(3, (3, 3), padding=1, dtype=jnp.float32,
+                       name="conv_out")(h.astype(jnp.float32))
+
+
+class VAEEncoder(nn.Module):
+    config: VAEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dt = cfg.jdtype
+        h = nn.Conv(cfg.block_channels[0], (3, 3), padding=1, dtype=dt,
+                    name="conv_in")(x.astype(dt))
+        for level, ch in enumerate(cfg.block_channels):
+            for j in range(cfg.layers_per_block):
+                h = ResnetBlock(ch, dt, name=f"down_{level}_res_{j}")(h)
+            if level < len(cfg.block_channels) - 1:
+                h = Downsample(ch, dt, name=f"down_{level}_ds")(h)
+        h = ResnetBlock(cfg.block_channels[-1], dt, name="mid_res_0")(h)
+        h = _MidAttention(dt, name="mid_attn")(h)
+        h = ResnetBlock(cfg.block_channels[-1], dt, name="mid_res_1")(h)
+        h = GroupNorm32(name="norm_out")(h)
+        h = nn.silu(h)
+        # mean + logvar
+        return nn.Conv(cfg.latent_channels * 2, (3, 3), padding=1,
+                       dtype=jnp.float32, name="conv_out")(h.astype(jnp.float32))
+
+
+def decode_to_images(pixels: jax.Array) -> jax.Array:
+    """[-1,1] float decoder output -> uint8 RGB, deterministic rounding."""
+    x = jnp.clip(pixels * 0.5 + 0.5, 0.0, 1.0)
+    return jnp.round(x * 255.0).astype(jnp.uint8)

@@ -1,0 +1,199 @@
+"""SD-1.5 family tests (tiny configs on the CPU mesh platform)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from arbius_tpu.models.sd15 import (
+    ByteTokenizer,
+    SD15Config,
+    SD15Pipeline,
+    TextEncoder,
+    TextEncoderConfig,
+    UNet2DCondition,
+    UNetConfig,
+    VAEConfig,
+    VAEDecoder,
+)
+
+
+class TestTokenizer:
+    def test_shape_and_specials(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("hello")
+        assert ids.shape == (77,)
+        assert ids[0] == 49406 and ids[6] == 49407
+        assert (ids[7:] == 49407).all()
+
+    def test_truncation(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("x" * 500)
+        assert ids.shape == (77,)
+        assert ids[-1] == 49407
+
+    def test_deterministic_and_distinct(self):
+        tok = ByteTokenizer()
+        assert (tok.encode("a cat") == tok.encode("a cat")).all()
+        assert not (tok.encode("a cat") == tok.encode("a dog")).all()
+
+    def test_batch(self):
+        tok = ByteTokenizer()
+        batch = tok.encode_batch(["a", "bb"])
+        assert batch.shape == (2, 77)
+
+
+class TestCLIPBPE:
+    @pytest.fixture()
+    def tok(self, tmp_path):
+        from arbius_tpu.models.sd15 import CLIPBPETokenizer
+        # tiny CLIP-style vocab: byte-unicode chars, merged pieces, </w> forms
+        vocab = {"<|startoftext|>": 0, "<|endoftext|>": 1,
+                 "a": 2, "c": 3, "t": 4, ".": 5,
+                 "a</w>": 6, "c</w>": 7, "t</w>": 8, ".</w>": 9,
+                 "ca": 10, "cat</w>": 11, "at</w>": 12}
+        merges = [("c", "a"), ("ca", "t</w>"), ("a", "t</w>")]
+        import json
+        vp, mp = tmp_path / "vocab.json", tmp_path / "merges.txt"
+        vp.write_text(json.dumps(vocab))
+        mp.write_text("#version\n" + "\n".join(" ".join(m) for m in merges))
+        return CLIPBPETokenizer.from_files(str(vp), str(mp))
+
+    def test_merge_ranking(self, tok):
+        ids = tok.encode("cat")
+        # c+a -> ca (rank 0), ca+t</w> -> cat</w> (rank 1)
+        assert list(ids[:3]) == [0, 11, 1]
+
+    def test_punctuation_split(self, tok):
+        # "cat." must split into cat + . (regex pre-tokenization), producing
+        # cat</w> then .</w> — not an unknown "cat.</w>" piece
+        ids = tok.encode("cat.")
+        assert list(ids[:4]) == [0, 11, 9, 1]
+
+    def test_unmerged_word_falls_to_chars(self, tok):
+        ids = tok.encode("tca")
+        # no merges apply except none for t,c,a order: t, c, a</w>
+        assert list(ids[:5]) == [0, 4, 3, 6, 1]
+
+    def test_lowercase_and_whitespace(self, tok):
+        assert (tok.encode("  CAT  ") == tok.encode("cat")).all()
+
+    def test_pad_and_truncate(self, tok):
+        ids = tok.encode("cat " * 200)
+        assert ids.shape == (77,)
+        assert ids[-1] == 1
+
+
+class TestModules:
+    def test_unet_shapes(self):
+        cfg = UNetConfig.tiny()
+        unet = UNet2DCondition(cfg)
+        x = jnp.zeros((2, 16, 16, 4))
+        t = jnp.zeros((2,))
+        ctx = jnp.zeros((2, 16, cfg.context_dim))
+        params = unet.init(jax.random.PRNGKey(0), x, t, ctx)["params"]
+        out = unet.apply({"params": params}, x, t, ctx)
+        assert out.shape == (2, 16, 16, 4)
+        assert out.dtype == jnp.float32
+
+    def test_unet_asymmetric_hw(self):
+        cfg = UNetConfig.tiny()
+        unet = UNet2DCondition(cfg)
+        x = jnp.zeros((1, 8, 16, 4))
+        params = unet.init(jax.random.PRNGKey(0), x, jnp.zeros((1,)),
+                           jnp.zeros((1, 16, cfg.context_dim)))["params"]
+        out = unet.apply({"params": params}, x, jnp.zeros((1,)),
+                         jnp.zeros((1, 16, cfg.context_dim)))
+        assert out.shape == (1, 8, 16, 4)
+
+    def test_vae_decoder_upsamples_8x(self):
+        cfg = VAEConfig.tiny()
+        vae = VAEDecoder(cfg)
+        z = jnp.zeros((1, 8, 8, 4))
+        params = vae.init(jax.random.PRNGKey(0), z)["params"]
+        out = vae.apply({"params": params}, z)
+        assert out.shape == (1, 64, 64, 3)
+
+    def test_text_encoder_causal(self):
+        cfg = TextEncoderConfig.tiny()
+        enc = TextEncoder(cfg)
+        ids = jnp.zeros((2, cfg.max_length), jnp.int32)
+        params = enc.init(jax.random.PRNGKey(0), ids)["params"]
+        base = enc.apply({"params": params}, ids)
+        assert base.shape == (2, cfg.max_length, cfg.width)
+        # causality: changing a later token must not affect earlier positions
+        ids2 = ids.at[:, -1].set(5)
+        out2 = enc.apply({"params": params}, ids2)
+        np.testing.assert_allclose(np.asarray(base[:, :-1]),
+                                   np.asarray(out2[:, :-1]), atol=1e-5)
+        assert not np.allclose(np.asarray(base[:, -1]), np.asarray(out2[:, -1]))
+
+
+class TestPipeline:
+    @pytest.fixture(scope="class")
+    def pipe(self):
+        # special ids must fit the tiny vocab (generate() enforces this)
+        return SD15Pipeline(SD15Config.tiny(),
+                            tokenizer=ByteTokenizer(max_length=16,
+                                                    bos_id=257, eos_id=258))
+
+    @pytest.fixture(scope="class")
+    def params(self, pipe):
+        return pipe.init_params(seed=0)
+
+    def test_generate_shape_dtype(self, pipe, params):
+        imgs = pipe.generate(params, ["a cat"], [""], [1337],
+                             width=64, height=64, num_inference_steps=3,
+                             scheduler="DDIM")
+        assert imgs.shape == (1, 64, 64, 3)
+        assert imgs.dtype == np.uint8
+
+    def test_bit_determinism_same_seed(self, pipe, params):
+        a = pipe.generate(params, ["a cat"], [""], [1337], width=64, height=64,
+                          num_inference_steps=3, scheduler="DDIM")
+        b = pipe.generate(params, ["a cat"], [""], [1337], width=64, height=64,
+                          num_inference_steps=3, scheduler="DDIM")
+        assert (a == b).all()
+
+    def test_seed_changes_output(self, pipe, params):
+        a = pipe.generate(params, ["a cat"], [""], [1], width=64, height=64,
+                          num_inference_steps=3, scheduler="DDIM")
+        b = pipe.generate(params, ["a cat"], [""], [2], width=64, height=64,
+                          num_inference_steps=3, scheduler="DDIM")
+        assert not (a == b).all()
+
+    def test_53bit_seed_space(self, pipe, params):
+        # seeds differing only in bits >32 must differ (taskid2seed is 53-bit)
+        s = 0x1FFFFFFFFFFFF0 - 1
+        a = pipe.generate(params, ["x"], [""], [s], width=64, height=64,
+                          num_inference_steps=2, scheduler="DDIM")
+        b = pipe.generate(params, ["x"], [""], [s & 0xFFFFFFFF], width=64,
+                          height=64, num_inference_steps=2, scheduler="DDIM")
+        assert not (a == b).all()
+
+    def test_batch_matches_singles(self, pipe, params):
+        # batching must not change per-sample bytes (batch-invariant numerics
+        # hold at fixed shapes because each sample's RNG is independent)
+        batch = pipe.generate(params, ["a", "b"], ["", ""], [10, 11],
+                              width=64, height=64, num_inference_steps=2,
+                              scheduler="DDIM", guidance_scale=[5.0, 9.0])
+        single0 = pipe.generate(params, ["a"], [""], [10], width=64, height=64,
+                                num_inference_steps=2, scheduler="DDIM",
+                                guidance_scale=[5.0])
+        np.testing.assert_array_equal(batch[0], single0[0])
+
+    def test_ancestral_scheduler_runs(self, pipe, params):
+        imgs = pipe.generate(params, ["a"], [""], [3], width=64, height=64,
+                             num_inference_steps=3, scheduler="K_EULER_ANCESTRAL")
+        assert imgs.shape == (1, 64, 64, 3)
+
+    def test_input_validation(self, pipe, params):
+        with pytest.raises(ValueError, match="align"):
+            pipe.generate(params, ["a", "b"], [""], [1], width=64, height=64)
+        with pytest.raises(ValueError, match="multiples"):
+            pipe.generate(params, ["a"], [""], [1], width=40, height=64)
+
+    def test_tokenizer_vocab_mismatch_is_loud(self, params):
+        bad = SD15Pipeline(SD15Config.tiny(), tokenizer=ByteTokenizer(max_length=16))
+        with pytest.raises(ValueError, match="vocab_size"):
+            bad.generate(params, ["a"], [""], [1], width=64, height=64,
+                         num_inference_steps=2, scheduler="DDIM")
