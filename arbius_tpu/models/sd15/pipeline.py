@@ -11,6 +11,13 @@ Batching: `generate` takes a batch of tasks sharing one shape bucket
 this a small finite set). Per-sample guidance scales and seeds vary freely
 within a batch. The runtime layer (arbius_tpu/runtime) groups queued tasks
 into buckets and shards the batch axis over the device mesh.
+
+Determinism vs batching: a task's output bytes must not depend on which
+other tasks happened to share its batch. XLA guarantees identical bits for
+identical compiled programs, but batch size is part of the program — so the
+runtime always pads a bucket to its CANONICAL batch size (dp_size × the
+bucket's per-chip batch) with dummy samples rather than compiling per
+occupancy. One program per bucket ⇒ one determinism class per bucket.
 """
 from __future__ import annotations
 
@@ -49,8 +56,10 @@ class SD15Pipeline:
 
     VAE_FACTOR = 8
 
-    def __init__(self, config: SD15Config | None = None, tokenizer=None):
+    def __init__(self, config: SD15Config | None = None, tokenizer=None,
+                 mesh=None):
         self.config = config or SD15Config()
+        self.mesh = mesh  # jax.sharding.Mesh with a 'dp' axis, or None
         if self.config.text.width != self.config.unet.context_dim:
             raise ValueError(
                 f"text encoder width ({self.config.text.width}) must equal "
@@ -77,6 +86,24 @@ class SD15Pipeline:
             "vae": self.vae.init(k2, latents)["params"],
             "text": self.text_encoder.init(k3, ids)["params"],
         }
+
+    def place_params(self, params: dict, tp_rules=()) -> dict:
+        """Shard params onto self.mesh (replicate by default, TP by rule)."""
+        if self.mesh is None:
+            return params
+        from arbius_tpu.parallel import shard_params
+
+        return shard_params(params, self.mesh, tp_rules)
+
+    def _place_batch(self, *arrays):
+        """Shard batch-leading arrays over the dp axis of the mesh."""
+        if self.mesh is None:
+            return arrays
+        from arbius_tpu.parallel import batch_sharding
+
+        return tuple(
+            jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+            for a in arrays)
 
     # -- compiled bucket -------------------------------------------------
     def _bucket_fn(self, batch: int, height: int, width: int,
@@ -162,12 +189,15 @@ class SD15Pipeline:
                 f"tokenizer produced id >= vocab_size ({vocab}); "
                 "tokenizer and text-encoder config are mismatched")
         seeds_arr = np.asarray(seeds, dtype=np.uint64)
-        images = fn(
-            params,
+        if self.mesh is not None and batch % self.mesh.shape["dp"]:
+            raise ValueError(
+                f"batch {batch} not divisible by dp={self.mesh.shape['dp']}")
+        args = self._place_batch(
             jnp.asarray(ids_c),
             jnp.asarray(ids_u),
             jnp.asarray(g, jnp.float32),
             jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
+        images = fn(params, *args)
         return np.asarray(images)

@@ -1,0 +1,50 @@
+"""Device-mesh parallelism for the TPU inference runtime.
+
+The reference scales by "one miner process per GPU" (docs/src/pages/
+mining.mdx:7 — single GPU only) with no intra-model parallelism of any
+kind (SURVEY.md §2.6). This package is the TPU-native replacement: a
+declarative mesh (dp / tp / sp axes) over which pjit/shard_map place the
+diffusion workloads, with XLA collectives riding ICI within a slice and
+DCN across hosts.
+
+Axes:
+  dp — data parallel: independent tasks batched across chips (the core
+       of the north-star metric, solutions/hour).
+  tp — tensor parallel: attention heads / conv channels sharded for
+       models whose activations exceed one chip's HBM.
+  sp — sequence/context parallel: video frame axis for UNet3D temporal
+       layers, spatial token axis for ring attention.
+"""
+from arbius_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    local_mesh,
+    mesh_axis_sizes,
+)
+from arbius_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated,
+    shard_params,
+    sharding_for,
+)
+from arbius_tpu.parallel.collectives import (
+    all_gather_seq,
+    halo_exchange,
+    ring_pass,
+)
+from arbius_tpu.parallel.distributed import initialize_distributed
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "local_mesh",
+    "mesh_axis_sizes",
+    "batch_sharding",
+    "replicated",
+    "shard_params",
+    "sharding_for",
+    "all_gather_seq",
+    "halo_exchange",
+    "ring_pass",
+    "initialize_distributed",
+]

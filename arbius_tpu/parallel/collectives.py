@@ -1,0 +1,67 @@
+"""Collective building blocks used inside shard_map'd model code.
+
+These are thin, named wrappers over lax collectives so model code reads
+as intent ("halo exchange over the frame axis") rather than plumbing.
+All are jit/scan safe and ride ICI when the mesh axis is intra-slice.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_gather_seq(x: jax.Array, axis_name: str, *, axis: int) -> jax.Array:
+    """Gather a sequence axis sharded over `axis_name` back to full length.
+
+    Used at sequence-parallel boundaries (e.g. before a temporal attention
+    that is cheaper gathered than ring-passed at small frame counts).
+    """
+    return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def ring_pass(x: jax.Array, axis_name: str, *, reverse: bool = False) -> jax.Array:
+    """Send this shard to the next device on the ring (ppermute).
+
+    The primitive under ring attention: each step every device hands its
+    current K/V block to its neighbour, so after N-1 steps everyone has
+    seen every block while only ever holding 1/N of the sequence.
+    """
+    n = lax.psum(1, axis_name)
+    shift = -1 if reverse else 1
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def halo_exchange(x: jax.Array, axis_name: str, *, axis: int, halo: int) -> jax.Array:
+    """Pad a sharded spatial/temporal axis with `halo` frames from each
+    neighbour (non-periodic: edge shards get zero padding).
+
+    This is what keeps temporal *convolutions* local under frame-axis
+    sequence parallelism: a kernel of size 2h+1 needs h neighbour frames
+    on each side, nothing more — O(halo) comms instead of an all-gather.
+    """
+    if halo > x.shape[axis]:
+        raise ValueError(
+            f"halo {halo} exceeds per-shard extent {x.shape[axis]} on axis "
+            f"{axis}; neighbours only hold {x.shape[axis]} frames")
+    idx = lax.axis_index(axis_name)
+    n = lax.psum(1, axis_name)
+
+    def take(a, sl):
+        ind = [slice(None)] * a.ndim
+        ind[axis] = sl
+        return a[tuple(ind)]
+
+    left_edge = take(x, slice(0, halo))            # my first frames -> left nbr
+    right_edge = take(x, slice(x.shape[axis] - halo, x.shape[axis]))
+
+    from_left = lax.ppermute(  # received from device idx-1
+        right_edge, axis_name, [(i, (i + 1) % n) for i in range(n)])
+    from_right = lax.ppermute(  # received from device idx+1
+        left_edge, axis_name, [(i, (i - 1) % n) for i in range(n)])
+
+    zeros = jnp.zeros_like(left_edge)
+    from_left = jnp.where(idx == 0, zeros, from_left)
+    from_right = jnp.where(idx == n - 1, zeros, from_right)
+    return jnp.concatenate([from_left, x, from_right], axis=axis)

@@ -1,0 +1,49 @@
+"""Multi-host initialization (DCN) for pod-slice deployments.
+
+The reference's only 'distributed backend' is HTTPS to sidecars
+(SURVEY.md §2.6). Here: jax.distributed over DCN for multi-host slices,
+then a single global mesh whose dp axis spans hosts (task batches are
+embarrassingly parallel, so dp-over-DCN costs nothing per step) while
+tp/sp stay intra-host on ICI.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Idempotent jax.distributed.initialize; no-op single-process.
+
+    Args default from the standard env (JAX_COORDINATOR_ADDRESS etc. /
+    TPU pod metadata), mirroring how operators configure the reference
+    miner via MiningConfig.json — config in, no hardcoding.
+    Returns True if a multi-process runtime was initialized.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+    if coordinator_address is None and num_processes in (None, 1):
+        return False  # single host, nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True

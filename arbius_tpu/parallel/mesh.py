@@ -1,0 +1,81 @@
+"""Mesh construction: declarative axis spec -> jax.sharding.Mesh.
+
+Replaces nothing in the reference (it has no distributed backend —
+SURVEY.md §2.6: transport is HTTP/JSON only); this is the TPU-native
+scaling substrate. Axis order is chosen so that the innermost mesh
+dimension (tp) maps to physically-adjacent chips where ICI bandwidth is
+highest, dp rides whatever is left, and sp sits between — matching the
+usual collective intensity ordering tp > sp > dp.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost -> innermost.
+AXIS_ORDER = ("dp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. -1 on exactly one axis means 'absorb the rest'."""
+
+    dp: int = -1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {"dp": self.dp, "sp": self.sp, "tp": self.tp}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one wildcard axis, got {wild}")
+        fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} wants {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def build_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
+    """Build a Mesh over `devices` (default: all) with the spec's shape.
+
+    Uses mesh_utils.create_device_mesh when the device set is the full
+    process view so the axis->ICI assignment is physically sensible;
+    falls back to a plain reshape for explicit device subsets.
+    """
+    spec = spec or MeshSpec()
+    subset = devices is not None
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices).reshape(-1)
+    sizes = spec.resolve(devices.size)
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    if subset:
+        # explicit subsets (tests, partial slices) have no topology claim
+        arr = devices.reshape(shape)
+    else:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    return Mesh(arr, AXIS_ORDER)
+
+
+def local_mesh(n: int | None = None, spec: MeshSpec | None = None) -> Mesh:
+    """Mesh over the first n local devices (testing / partial-slice use)."""
+    devs = jax.devices()
+    if n is not None:
+        if n > len(devs):
+            raise ValueError(f"asked for {n} devices, have {len(devs)}")
+        devs = devs[:n]
+    return build_mesh(spec or MeshSpec(), devices=devs)
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

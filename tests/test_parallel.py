@@ -1,0 +1,146 @@
+"""Parallel layer tests on the virtual 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from arbius_tpu.parallel import (
+    MeshSpec,
+    all_gather_seq,
+    batch_sharding,
+    build_mesh,
+    halo_exchange,
+    local_mesh,
+    mesh_axis_sizes,
+    ring_pass,
+    shard_params,
+)
+from arbius_tpu.parallel.sharding import DEFAULT_TP_RULES
+
+
+def test_devices_virtualized():
+    assert len(jax.devices()) == 8
+
+
+def test_meshspec_resolve_wildcard():
+    assert MeshSpec().resolve(8) == {"dp": 8, "sp": 1, "tp": 1}
+    assert MeshSpec(dp=-1, tp=2).resolve(8) == {"dp": 4, "sp": 1, "tp": 2}
+    assert MeshSpec(dp=2, sp=2, tp=2).resolve(8) == {"dp": 2, "sp": 2, "tp": 2}
+
+
+def test_meshspec_resolve_errors():
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3, tp=2).resolve(8)  # 6 != 8
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=3).resolve(8)  # 8 % 3
+
+
+def test_build_mesh_shapes():
+    mesh = build_mesh(MeshSpec(dp=2, sp=2, tp=2))
+    assert mesh_axis_sizes(mesh) == {"dp": 2, "sp": 2, "tp": 2}
+    mesh = local_mesh(4)
+    assert mesh_axis_sizes(mesh) == {"dp": 4, "sp": 1, "tp": 1}
+
+
+def test_batch_sharding_places_shards():
+    mesh = build_mesh(MeshSpec(dp=8))
+    x = jnp.arange(16.0).reshape(16, 1)
+    xs = jax.device_put(x, batch_sharding(mesh, x.ndim))
+    assert len(xs.addressable_shards) == 8
+    assert xs.addressable_shards[0].data.shape == (2, 1)
+    np.testing.assert_array_equal(np.asarray(xs), np.asarray(x))
+
+
+def test_shard_params_tp_rules():
+    mesh = build_mesh(MeshSpec(dp=4, tp=2))
+    params = {
+        "blk": {"to_q": {"kernel": jnp.ones((8, 16))},
+                "to_out": {"kernel": jnp.ones((16, 8))}},
+        "other": {"kernel": jnp.ones((3, 3))},
+    }
+    out = shard_params(params, mesh, DEFAULT_TP_RULES)
+    q = out["blk"]["to_q"]["kernel"]
+    o = out["blk"]["to_out"]["kernel"]
+    # tp=2: q sharded on out-dim, o on in-dim, other replicated
+    assert q.sharding.spec == P(None, "tp")
+    assert o.sharding.spec == P("tp", None)
+    assert out["other"]["kernel"].sharding.spec == P()
+
+
+def test_shard_params_skips_indivisible():
+    mesh = build_mesh(MeshSpec(dp=4, tp=2))
+    params = {"to_q": {"kernel": jnp.ones((8, 3))}}  # 3 % 2 != 0
+    out = shard_params(params, mesh, DEFAULT_TP_RULES)
+    assert out["to_q"]["kernel"].sharding.spec == P()
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def test_all_gather_seq_roundtrip():
+    mesh = build_mesh(MeshSpec(dp=1, sp=8, tp=1))
+    x = jnp.arange(32.0).reshape(16, 2)
+
+    fn = _shard_map(
+        lambda a: all_gather_seq(a, "sp", axis=0),
+        mesh, in_specs=P("sp", None), out_specs=P(None, None))
+    np.testing.assert_array_equal(np.asarray(fn(x)), np.asarray(x))
+
+
+def test_ring_pass_rotates():
+    mesh = build_mesh(MeshSpec(dp=1, sp=8, tp=1))
+    x = jnp.arange(8.0).reshape(8, 1)
+    fn = _shard_map(lambda a: ring_pass(a, "sp"), mesh,
+                    in_specs=P("sp", None), out_specs=P("sp", None))
+    out = np.asarray(fn(x)).ravel()
+    # device i's value moves to device i+1 -> output shard i holds x[i-1]
+    np.testing.assert_array_equal(out, np.roll(np.arange(8.0), 1))
+
+
+def test_halo_exchange_matches_zero_padding():
+    mesh = local_mesh(4, MeshSpec(dp=1, sp=4, tp=1))
+    frames = jnp.arange(16.0).reshape(16, 1)  # 4 frames per device
+    halo = 2
+
+    fn = _shard_map(
+        lambda a: halo_exchange(a, "sp", axis=0, halo=halo),
+        mesh, in_specs=P("sp", None), out_specs=P("sp", None))
+    out = np.asarray(fn(frames))  # [4*(4+2*2), 1] = [32, 1]
+    shards = out.reshape(4, 4 + 2 * halo)
+    full = np.pad(np.arange(16.0), halo)
+    for i in range(4):
+        np.testing.assert_array_equal(shards[i], full[i * 4:i * 4 + 4 + 2 * halo])
+
+
+def test_dp_inference_deterministic():
+    """The determinism contract is run-to-run bit-equality of the SAME
+    compiled program (SURVEY.md §7 hard part 1) — assert that for a
+    dp-sharded graph, and numerical closeness to the eager reference
+    (jit/eager bit-equality is NOT promised; fusion changes rounding)."""
+    mesh = build_mesh(MeshSpec(dp=8))
+    w = jnp.ones((4, 4)) * 0.5
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 4))
+
+    def step(w, x):
+        return jnp.tanh(x @ w)
+
+    xs = jax.device_put(x, batch_sharding(mesh, 2))
+    ws = jax.device_put(w, jax.sharding.NamedSharding(mesh, P()))
+    fn = jax.jit(step)
+    got1 = np.asarray(fn(ws, xs))
+    got2 = np.asarray(fn(ws, xs))
+    np.testing.assert_array_equal(got1, got2)
+    np.testing.assert_allclose(got1, np.asarray(step(w, x)), rtol=1e-6)
+
+
+def test_halo_exchange_rejects_oversize_halo():
+    mesh = local_mesh(4, MeshSpec(dp=1, sp=4, tp=1))
+    frames = jnp.arange(4.0).reshape(4, 1)  # 1 frame per device
+    fn = _shard_map(
+        lambda a: halo_exchange(a, "sp", axis=0, halo=2),
+        mesh, in_specs=P("sp", None), out_specs=P("sp", None))
+    with pytest.raises(ValueError, match="halo"):
+        fn(frames)

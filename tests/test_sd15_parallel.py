@@ -1,0 +1,38 @@
+"""SD-1.5 pipeline on the virtual dp mesh: shards run, bits reproduce."""
+import numpy as np
+
+from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
+from arbius_tpu.parallel import MeshSpec, build_mesh
+
+
+def test_sd15_dp_mesh_reproducible():
+    mesh = build_mesh(MeshSpec(dp=8))
+    pipe = SD15Pipeline(SD15Config.tiny(), mesh=mesh,
+                        tokenizer=ByteTokenizer(max_length=16,
+                                                bos_id=257, eos_id=258))
+    params = pipe.place_params(pipe.init_params(seed=7))
+    kw = dict(width=64, height=64, num_inference_steps=2, scheduler="DDIM")
+    prompts = [f"task {i}" for i in range(8)]
+    negs = [""] * 8
+    seeds = list(range(100, 108))
+    a = pipe.generate(params, prompts, negs, seeds, **kw)
+    b = pipe.generate(params, prompts, negs, seeds, **kw)
+    assert a.shape == (8, 64, 64, 3) and a.dtype == np.uint8
+    np.testing.assert_array_equal(a, b)
+    # different seeds -> different images (sanity that dp lanes are live)
+    assert not np.array_equal(a[0], a[1])
+
+
+def test_sd15_dp_mesh_batch_divisibility():
+    mesh = build_mesh(MeshSpec(dp=8))
+    pipe = SD15Pipeline(SD15Config.tiny(), mesh=mesh,
+                        tokenizer=ByteTokenizer(max_length=16,
+                                                bos_id=257, eos_id=258))
+    params = pipe.place_params(pipe.init_params(seed=7))
+    try:
+        pipe.generate(params, ["x"] * 3, [""] * 3, [1, 2, 3],
+                      width=64, height=64, num_inference_steps=1)
+    except ValueError as e:
+        assert "divisible" in str(e)
+    else:
+        raise AssertionError("expected divisibility error")
