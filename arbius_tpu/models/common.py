@@ -126,8 +126,12 @@ class GEGLU(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        h = nn.Dense(self.dim_out * 2, dtype=self.dtype)(x)
-        h, gate = jnp.split(h, 2, axis=-1)
+        # value/gate as two named projections (not one fused kernel) so a
+        # tensor-parallel P(None, 'tp') sharding keeps each half's columns
+        # local to a chip — a fused kernel's midpoint split would straddle
+        # the tp shards and force a reshard before the elementwise gate.
+        h = nn.Dense(self.dim_out, dtype=self.dtype, name="ff_val")(x)
+        gate = nn.Dense(self.dim_out, dtype=self.dtype, name="ff_gate")(x)
         return h * nn.gelu(gate)
 
 
@@ -144,8 +148,8 @@ class TransformerBlock(nn.Module):
         x = x + Attention(self.num_heads, self.head_dim, self.dtype, name="attn2")(
             nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype), context=context)
         h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
-        h = GEGLU(x.shape[-1] * 4, self.dtype)(h)
-        h = nn.Dense(x.shape[-1], dtype=self.dtype)(h)
+        h = GEGLU(x.shape[-1] * 4, self.dtype, name="ff")(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="ff_out")(h)
         return x + h
 
 

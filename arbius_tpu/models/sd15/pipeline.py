@@ -87,12 +87,18 @@ class SD15Pipeline:
             "text": self.text_encoder.init(k3, ids)["params"],
         }
 
-    def place_params(self, params: dict, tp_rules=()) -> dict:
-        """Shard params onto self.mesh (replicate by default, TP by rule)."""
+    def place_params(self, params: dict, tp_rules=None) -> dict:
+        """Shard params onto self.mesh: TP kernels by rule (the family's
+        DEFAULT_TP_RULES unless overridden), everything else replicated.
+        On a tp=1 mesh the rules degrade to replication, so the default
+        is always safe — and on tp>1 it is required (replicating every
+        param would waste the tp axis entirely)."""
         if self.mesh is None:
             return params
-        from arbius_tpu.parallel import shard_params
+        from arbius_tpu.parallel import DEFAULT_TP_RULES, shard_params
 
+        if tp_rules is None:
+            tp_rules = DEFAULT_TP_RULES
         return shard_params(params, self.mesh, tp_rules)
 
     def _place_batch(self, *arrays):

@@ -19,9 +19,9 @@ from arbius_tpu.parallel.mesh import (
     MeshSpec,
     build_mesh,
     local_mesh,
-    mesh_axis_sizes,
 )
 from arbius_tpu.parallel.sharding import (
+    DEFAULT_TP_RULES,
     batch_sharding,
     replicated,
     shard_params,
@@ -35,10 +35,10 @@ from arbius_tpu.parallel.collectives import (
 from arbius_tpu.parallel.distributed import initialize_distributed
 
 __all__ = [
+    "DEFAULT_TP_RULES",
     "MeshSpec",
     "build_mesh",
     "local_mesh",
-    "mesh_axis_sizes",
     "batch_sharding",
     "replicated",
     "shard_params",

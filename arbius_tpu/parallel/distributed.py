@@ -19,12 +19,17 @@ def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    *,
+    auto_detect: bool | None = None,
 ) -> bool:
     """Idempotent jax.distributed.initialize; no-op single-process.
 
-    Args default from the standard env (JAX_COORDINATOR_ADDRESS etc. /
-    TPU pod metadata), mirroring how operators configure the reference
-    miner via MiningConfig.json — config in, no hardcoding.
+    Args default from the standard env (JAX_COORDINATOR_ADDRESS etc.);
+    when none are given and `auto_detect` is true (default: true exactly
+    when running on TPU hardware), falls back to the no-arg
+    `jax.distributed.initialize()`, which reads TPU pod metadata — the
+    standard way multi-host slices are configured. Off-TPU (CPU tests,
+    single host) the no-arg call would fail, so it is skipped.
     Returns True if a multi-process runtime was initialized.
     """
     global _initialized
@@ -39,7 +44,13 @@ def initialize_distributed(
         env = os.environ.get("JAX_PROCESS_ID")
         process_id = int(env) if env else None
     if coordinator_address is None and num_processes in (None, 1):
-        return False  # single host, nothing to do
+        if auto_detect is None:
+            auto_detect = jax.default_backend() == "tpu"
+        if not auto_detect:
+            return False  # single host, nothing to do
+        jax.distributed.initialize()  # TPU pod metadata auto-detection
+        _initialized = True
+        return jax.process_count() > 1
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -29,6 +29,9 @@ class MeshSpec:
 
     def resolve(self, n_devices: int) -> dict[str, int]:
         sizes = {"dp": self.dp, "sp": self.sp, "tp": self.tp}
+        bad = {k: v for k, v in sizes.items() if v < 1 and v != -1}
+        if bad:
+            raise ValueError(f"axis sizes must be >= 1 (or -1 wildcard): {bad}")
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one wildcard axis, got {wild}")
@@ -75,7 +78,3 @@ def local_mesh(n: int | None = None, spec: MeshSpec | None = None) -> Mesh:
             raise ValueError(f"asked for {n} devices, have {len(devs)}")
         devs = devs[:n]
     return build_mesh(spec or MeshSpec(), devices=devs)
-
-
-def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
-    return dict(zip(mesh.axis_names, mesh.devices.shape))

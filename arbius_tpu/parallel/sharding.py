@@ -22,8 +22,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DEFAULT_TP_RULES: tuple[tuple[str, P], ...] = (
     (r".*(to_q|to_k|to_v)/kernel$", P(None, "tp")),
     (r".*to_out/kernel$", P("tp", None)),
-    (r".*GEGLU_\d+/Dense_\d+/kernel$", P(None, "tp")),
-    (r".*TransformerBlock_\d+/Dense_\d+/kernel$", P("tp", None)),
+    (r".*/ff/(ff_val|ff_gate)/kernel$", P(None, "tp")),
+    (r".*/ff_out/kernel$", P("tp", None)),
 )
 
 
@@ -71,8 +71,10 @@ def shard_params(
         name = _path_str(path)
         for pat, spec in compiled:
             if pat.match(name):
-                # drop axes the leaf can't divide (e.g. tiny test configs)
-                ok = all(
+                # replicate when the rule doesn't apply to this leaf: rank
+                # mismatch (a conv rule matching a dense kernel) or an axis
+                # the leaf can't divide (e.g. tiny test configs)
+                ok = len(spec) <= leaf.ndim and all(
                     s is None or leaf.shape[i] % _axis_size(mesh, s) == 0
                     for i, s in enumerate(spec)
                 )

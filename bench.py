@@ -1,0 +1,75 @@
+"""Benchmark: solutions/hour/chip on the anythingv3 task shape.
+
+Runs the flagship SD-1.5 solve step (full production topology: ViT-L text
+tower, 860M-param-class UNet2DCondition, VAE decoder) at the BASELINE.md
+metric config — 512×512, 20 denoise steps, DPMSolverMultistep, CFG — and
+reports steady-state throughput as solutions/hour on the local device(s).
+
+The reference publishes no benchmark numbers (BASELINE.md: `published:{}`);
+`vs_baseline` is measured against the documented anchor of a single-A100
+cog miner on the same task shape, ~0.5 solutions/s end-to-end inference
+(≈1800 solutions/hour) — the hardware class the reference requires
+(docs/src/pages/mining.mdx:7-19). Weights are deterministically random
+(init_params); FLOPs and memory traffic are identical to converted weights,
+so throughput is representative.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+A100_SOLUTIONS_PER_HOUR = 1800.0  # documented anchor, see module docstring
+
+WIDTH = HEIGHT = 512
+STEPS = 20
+SCHEDULER = "DPMSolverMultistep"
+
+
+def main() -> None:
+    from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
+
+    n_dev = len(jax.devices())
+    batch = max(1, n_dev)  # one task per chip — the dp unit of the miner
+    mesh = None
+    if n_dev > 1:
+        from arbius_tpu.parallel import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(dp=n_dev))
+
+    cfg = SD15Config()  # full production topology
+    pipe = SD15Pipeline(cfg, mesh=mesh, tokenizer=ByteTokenizer())
+    params = pipe.place_params(pipe.init_params(seed=0,
+                                                height=HEIGHT, width=WIDTH))
+
+    kw = dict(width=WIDTH, height=HEIGHT, num_inference_steps=STEPS,
+              scheduler=SCHEDULER, guidance_scale=12.0)
+    prompts = [f"arbius bench task {i}" for i in range(batch)]
+    negs = [""] * batch
+
+    # warmup: compile the bucket + one steady-state run
+    pipe.generate(params, prompts, negs, list(range(batch)), **kw)
+
+    rounds = 3
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        out = pipe.generate(params, prompts, negs,
+                            [r * batch + i for i in range(batch)], **kw)
+    dt = time.perf_counter() - t0
+    assert out.shape == (batch, HEIGHT, WIDTH, 3) and out.dtype == np.uint8
+
+    per_chip = (rounds * batch / dt) * 3600.0 / n_dev
+    print(json.dumps({
+        "metric": "anythingv3_solutions_per_hour_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "solutions/hour/chip (SD-1.5 512x512, 20 steps, DPM++)",
+        "vs_baseline": round(per_chip / A100_SOLUTIONS_PER_HOUR, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,7 +12,6 @@ from arbius_tpu.parallel import (
     build_mesh,
     halo_exchange,
     local_mesh,
-    mesh_axis_sizes,
     ring_pass,
     shard_params,
 )
@@ -38,9 +37,9 @@ def test_meshspec_resolve_errors():
 
 def test_build_mesh_shapes():
     mesh = build_mesh(MeshSpec(dp=2, sp=2, tp=2))
-    assert mesh_axis_sizes(mesh) == {"dp": 2, "sp": 2, "tp": 2}
+    assert dict(mesh.shape) == {"dp": 2, "sp": 2, "tp": 2}
     mesh = local_mesh(4)
-    assert mesh_axis_sizes(mesh) == {"dp": 4, "sp": 1, "tp": 1}
+    assert dict(mesh.shape) == {"dp": 4, "sp": 1, "tp": 1}
 
 
 def test_batch_sharding_places_shards():
@@ -66,6 +65,28 @@ def test_shard_params_tp_rules():
     assert q.sharding.spec == P(None, "tp")
     assert o.sharding.spec == P("tp", None)
     assert out["other"]["kernel"].sharding.spec == P()
+
+
+def test_tp_rules_hit_real_sd15_param_tree():
+    """Every rule must match real flax param paths — synthetic-path tests
+    can't catch a dead rule (a regex written for auto-names that the model
+    never produces silently replicates the weight)."""
+    import re
+
+    from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline, ByteTokenizer
+
+    pipe = SD15Pipeline(SD15Config.tiny(),
+                        tokenizer=ByteTokenizer(max_length=16,
+                                                bos_id=257, eos_id=258))
+    params = pipe.init_params(seed=0)
+    paths = []
+    jax.tree_util.tree_map_with_path(
+        lambda p, _: paths.append("/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in p)),
+        params)
+    for pat, _ in DEFAULT_TP_RULES:
+        hits = [p for p in paths if re.match(pat, p)]
+        assert hits, f"TP rule {pat!r} matches nothing in the SD15 tree"
 
 
 def test_shard_params_skips_indivisible():
