@@ -1,0 +1,18 @@
+"""Deterministic media codecs — the artifact-byte layer of the framework.
+
+The solution CID is computed over the *encoded* output files (SURVEY.md §7
+hard part #2); the reference outsources encoding to its cog containers, we
+own it. Everything here is pinned by specification (integer math, fixed
+parameters, no library-version-dependent compressors) so a fleet of miners
+produces identical bytes, hence identical CIDs.
+"""
+from arbius_tpu.codecs.deflate import compress as deflate_compress
+from arbius_tpu.codecs.deflate import deflate_fixed, zlib_compress
+from arbius_tpu.codecs.jpeg import encode_jpeg
+from arbius_tpu.codecs.mp4 import encode_mp4, mux_mjpeg_mp4
+from arbius_tpu.codecs.png import encode_png
+
+__all__ = [
+    "deflate_compress", "deflate_fixed", "zlib_compress",
+    "encode_jpeg", "encode_mp4", "mux_mjpeg_mp4", "encode_png",
+]

@@ -1,0 +1,70 @@
+"""Loader for the native codec core (native/codecs.cc).
+
+Tries, in order: a prebuilt `native/build/libarbius_codecs.so`, building one
+with g++ on first use (cached on disk), else returns None so callers fall
+back to the pure-Python reference implementation. Both paths implement the
+same byte-exact spec, so the fallback changes speed, never output.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "codecs.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libarbius_codecs.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) and os.path.exists(_SRC):
+            try:
+                os.makedirs(_BUILD_DIR, exist_ok=True)
+                tmp = _SO + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _SO)
+            except Exception:
+                return None
+        if not os.path.exists(_SO):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.arbius_deflate_fixed.restype = ctypes.c_size_t
+            lib.arbius_deflate_fixed.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def deflate_fixed():
+    """Return a bytes->bytes compressor backed by the .so, or None."""
+    lib = _load()
+    if lib is None:
+        return None
+
+    def fn(data: bytes) -> bytes:
+        # worst case fixed-Huffman: 9 bits/literal + 3-bit header + EOB
+        cap = len(data) + len(data) // 4 + 64
+        out = (ctypes.c_uint8 * cap)()
+        written = lib.arbius_deflate_fixed(data, len(data), out, cap)
+        if written == 0 and data:
+            raise RuntimeError("native deflate overflow (bug: cap too small)")
+        return bytes(out[:written])
+
+    return fn
