@@ -1,0 +1,33 @@
+"""Chain layer (L1'): protocol state machine + emission math.
+
+`Engine` is an in-process, behavior-exact EngineV1 for integration tests
+and local mining (the reference's untested seam, SURVEY.md §4); the
+emission curve in `fixedpoint` is bit-exact against the on-chain PRB-math
+fixed-point code, so reward/difficulty predictions match chain state.
+"""
+from arbius_tpu.chain.engine import (
+    Contestation,
+    Engine,
+    EngineError,
+    Event,
+    Model,
+    Solution,
+    Task,
+    Validator,
+)
+from arbius_tpu.chain.fixedpoint import (
+    BASE_TOKEN_STARTING_REWARD,
+    STARTING_ENGINE_TOKEN_AMOUNT,
+    WAD,
+    diff_mul,
+    reward,
+    target_ts,
+)
+from arbius_tpu.chain.token import TokenLedger
+
+__all__ = [
+    "Contestation", "Engine", "EngineError", "Event", "Model", "Solution",
+    "Task", "Validator", "TokenLedger",
+    "BASE_TOKEN_STARTING_REWARD", "STARTING_ENGINE_TOKEN_AMOUNT", "WAD",
+    "diff_mul", "reward", "target_ts",
+]

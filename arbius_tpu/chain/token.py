@@ -1,0 +1,42 @@
+"""In-process ERC20 ledger — the AIUS base token for the fake chain.
+
+Mirrors what the engine needs of BaseTokenV1 (`BaseTokenV1.sol:37-68`):
+balances, allowances, transfer/transferFrom. Fixed 1M wad supply minted to
+a deployer, of which the engine is seeded with 600k (the mining emission
+pool, `EngineV1.sol:12-13` MAX_SUPPLY/STARTING_ENGINE_TOKEN_AMOUNT).
+"""
+from __future__ import annotations
+
+from arbius_tpu.chain.fixedpoint import WAD
+
+MAX_SUPPLY = 1_000_000 * WAD
+
+
+class TokenLedger:
+    def __init__(self):
+        self.balances: dict[str, int] = {}
+        self.allowances: dict[tuple[str, str], int] = {}
+
+    def mint(self, to: str, amount: int) -> None:
+        self.balances[to] = self.balances.get(to, 0) + amount
+
+    def balance_of(self, addr: str) -> int:
+        return self.balances.get(addr, 0)
+
+    def approve(self, owner: str, spender: str, amount: int) -> None:
+        self.allowances[(owner, spender)] = amount
+
+    def transfer(self, sender: str, to: str, amount: int) -> None:
+        bal = self.balances.get(sender, 0)
+        if bal < amount:
+            raise ValueError("ERC20: transfer amount exceeds balance")
+        self.balances[sender] = bal - amount
+        self.balances[to] = self.balances.get(to, 0) + amount
+
+    def transfer_from(self, spender: str, owner: str, to: str,
+                      amount: int) -> None:
+        allowed = self.allowances.get((owner, spender), 0)
+        if allowed < amount:
+            raise ValueError("ERC20: insufficient allowance")
+        self.allowances[(owner, spender)] = allowed - amount
+        self.transfer(owner, to, amount)

@@ -1,0 +1,476 @@
+"""Protocol state-machine tests — the fake-chain mirror of the reference's
+`contract/test/base.test.ts` + `reward.test.ts` matrices (SURVEY.md §4):
+emission goldens, validator lifecycle, commit-reveal, claim fee splits,
+and contestations across voter counts, outcomes, pagination, and the
+slashing threshold.
+"""
+from __future__ import annotations
+
+import pytest
+
+from arbius_tpu.chain import (
+    Engine,
+    EngineError,
+    TokenLedger,
+    WAD,
+    diff_mul,
+    reward,
+    target_ts,
+)
+
+DEPLOYER = "0x" + "d0" * 20
+USER = "0x" + "01" * 20
+V1 = "0x" + "11" * 20
+V2 = "0x" + "12" * 20
+V3 = "0x" + "13" * 20
+V4 = "0x" + "14" * 20
+MODEL_ADDR = "0x" + "33" * 20
+TEMPLATE = b'{"meta":{"title":"test model"}}'
+
+
+def make_engine(*, seed_engine=600_000 * WAD, validators=(), stake=100 * WAD):
+    """Fresh engine + funded accounts; optionally pre-staked validators.
+
+    `seed_engine` is the engine's token balance: pseudo-total-supply is
+    600k minus this (EngineV1.sol:521-527), so the deployment default
+    600k means supply 0 (nothing mined yet, no validator minimum) and
+    e.g. 597k means supply 3000 (past both activation thresholds). Note
+    stake deposits flow INTO the engine and lower the supply again.
+    """
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=1000)
+    tok.mint(Engine.ADDRESS, seed_engine)
+    for a in (DEPLOYER, USER, V1, V2, V3, V4):
+        tok.mint(a, 1000 * WAD)
+        tok.approve(a, Engine.ADDRESS, 10**30)
+    for v in validators:
+        eng.validator_deposit(v, v, stake)
+    return eng, tok
+
+
+def bootstrap_task(eng, *, fee=0, rate=0):
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, fee, TEMPLATE)
+    if rate:
+        eng.set_solution_mineable_rate(mid, rate)
+    tid = eng.submit_task(USER, 0, USER, mid, fee, b'{"prompt":"cat"}')
+    return mid, tid
+
+
+def solve(eng, tid, validator=V1, cid=b"\x12\x20" + b"\xaa" * 32):
+    com = eng.generate_commitment(validator, tid, cid)
+    eng.signal_commitment(validator, com)
+    eng.mine_block()
+    eng.submit_solution(validator, tid, cid)
+    return cid
+
+
+# -- emission goldens (reward.test.ts:154-179) -----------------------------
+
+TARGET_TS_GOLDEN = [
+    (0, 0),
+    (15768000, 175735931288071485118987),
+    (31536000, 300000 * WAD),
+    (63072000, 450000 * WAD),
+    (94608000, 525000 * WAD),
+    (126144000, 562500 * WAD),
+    (157680000, 581250 * WAD),
+    (315360000, 599414062500000000000000),
+    (3153600000, 600000 * WAD),
+    (31536000000, 600000 * WAD),
+]
+
+
+@pytest.mark.parametrize("t,expected", TARGET_TS_GOLDEN)
+def test_target_ts_golden(t, expected):
+    assert target_ts(t) == expected
+
+
+DIFF_MUL_GOLDEN = [
+    (100000, 100 * WAD),
+    (250000, 100 * WAD),
+    (300000, 1 * WAD),
+    (305000, 314980262473718305),
+    (350000, 9612434767874),
+    (355000, 3027727226196),
+    (360000, 0),
+    (400000, 0),
+    (500000, 0),
+    (600000, 0),
+]
+
+
+@pytest.mark.parametrize("ts,expected", DIFF_MUL_GOLDEN)
+def test_diff_mul_golden(ts, expected):
+    assert diff_mul(31536000, ts * WAD) == expected
+
+
+def test_reward_zero_supply_default():
+    assert reward(1, 0) == WAD
+
+
+# -- tasks + solutions -----------------------------------------------------
+
+def test_task_ids_chain_through_prevhash():
+    eng, _ = make_engine()
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, 0, TEMPLATE)
+    t1 = eng.submit_task(USER, 0, USER, mid, 0, b"a")
+    t2 = eng.submit_task(USER, 0, USER, mid, 0, b"a")
+    assert t1 != t2  # same inputs, different id: anti-pregeneration chain
+    assert eng.prevhash == t2
+
+
+def test_submit_task_requires_model_and_fee():
+    eng, _ = make_engine()
+    with pytest.raises(EngineError, match="model does not exist"):
+        eng.submit_task(USER, 0, USER, b"\x99" * 32, 0, b"x")
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, 5 * WAD, TEMPLATE)
+    with pytest.raises(EngineError, match="lower fee"):
+        eng.submit_task(USER, 0, USER, mid, 4 * WAD, b"x")
+
+
+def test_commit_reveal_happy_path_and_first_wins():
+    eng, _ = make_engine(validators=(V1, V2))
+    _, tid = bootstrap_task(eng)
+    solve(eng, tid, V1)
+    assert eng.solutions[tid].validator == V1
+    # second reveal loses
+    cid2 = b"\x12\x20" + b"\xbb" * 32
+    com2 = eng.generate_commitment(V2, tid, cid2)
+    eng.signal_commitment(V2, com2)
+    eng.mine_block()
+    with pytest.raises(EngineError, match="solution already submitted"):
+        eng.submit_solution(V2, tid, cid2)
+
+
+def test_commitment_must_age_one_block():
+    eng, _ = make_engine(validators=(V1,))
+    _, tid = bootstrap_task(eng)
+    cid = b"\x12\x20" + b"\xaa" * 32
+    eng.signal_commitment(V1, eng.generate_commitment(V1, tid, cid))
+    with pytest.raises(EngineError, match="commitment must be in past"):
+        eng.submit_solution(V1, tid, cid)  # same block
+    with pytest.raises(EngineError, match="non existent commitment"):
+        eng.submit_solution(V1, tid, b"\x12\x20" + b"\xcc" * 32)
+
+
+def test_commitment_cannot_be_reset():
+    eng, _ = make_engine(validators=(V1,))
+    _, tid = bootstrap_task(eng)
+    com = eng.generate_commitment(V1, tid, b"\x01")
+    eng.signal_commitment(V1, com)
+    with pytest.raises(EngineError, match="commitment exists"):
+        eng.signal_commitment(V2, com)
+
+
+def test_claim_fee_split():
+    """fee 10: model fee 1 → model addr; 10% of the rest (0.9) accrues to
+    treasury; solver gets 8.1 (EngineV1.sol:819-862)."""
+    eng, tok = make_engine()
+    eng.validator_deposit(V1, V1, 100 * WAD)
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, 1 * WAD, TEMPLATE)
+    tid = eng.submit_task(USER, 0, USER, mid, 10 * WAD, b"in")
+    solve(eng, tid, V1)
+    bal0 = tok.balance_of(V1)
+    eng.advance_time(2001)
+    eng.claim_solution(USER, tid)  # anyone can claim; reward goes to solver
+    assert tok.balance_of(MODEL_ADDR) == 1 * WAD
+    assert eng.accrued_fees == 9 * WAD // 10
+    assert tok.balance_of(V1) - bal0 == 81 * WAD // 10
+    with pytest.raises(EngineError, match="already claimed"):
+        eng.claim_solution(USER, tid)
+
+
+def test_claim_requires_delay():
+    eng, _ = make_engine(validators=(V1,))
+    _, tid = bootstrap_task(eng)
+    solve(eng, tid)
+    with pytest.raises(EngineError, match="not enough delay"):
+        eng.claim_solution(V1, tid)
+
+
+def test_claim_with_mineable_reward():
+    """rate 0.1 model on an engine holding 590k: supply=10k, reward flows
+    90/10 solver/treasury (reward.test.ts:189-233 flow)."""
+    eng, tok = make_engine(seed_engine=590_000 * WAD)
+    eng.validator_deposit(V1, V1, 100 * WAD)
+    mid, tid = None, None
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, 0, TEMPLATE)
+    eng.set_solution_mineable_rate(mid, WAD // 10)
+    # a year in: target supply 300k >> actual 10k, so diffMul caps at 100x
+    eng.advance_time(31536000)
+    tid = eng.submit_task(USER, 0, USER, mid, 0, b"in")
+    solve(eng, tid, V1)
+    bal0, tre0 = tok.balance_of(V1), tok.balance_of(eng.treasury)
+    eng.advance_time(2001)
+    total = (eng.get_reward() * (WAD // 10)) // WAD
+    eng.claim_solution(USER, tid)
+    treasury_cut = total - (total * (WAD - WAD // 10)) // WAD
+    assert tok.balance_of(V1) - bal0 == total - treasury_cut
+    assert tok.balance_of(eng.treasury) - tre0 == treasury_cut
+    assert total > 0
+
+
+def test_retract_task():
+    eng, tok = make_engine()
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, 0, TEMPLATE)
+    tid = eng.submit_task(USER, 0, USER, mid, 10 * WAD, b"in")
+    with pytest.raises(EngineError, match="did not wait long enough"):
+        eng.retract_task(USER, tid)
+    eng.advance_time(10001)
+    bal0 = tok.balance_of(USER)
+    eng.retract_task(USER, tid)
+    assert tok.balance_of(USER) - bal0 == 9 * WAD
+    assert eng.accrued_fees == 1 * WAD
+    assert tid not in eng.tasks
+
+
+def test_retract_blocked_after_solution():
+    eng, _ = make_engine(validators=(V1,))
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, 0, TEMPLATE)
+    tid = eng.submit_task(USER, 0, USER, mid, 0, b"in")
+    solve(eng, tid)
+    eng.advance_time(10001)
+    with pytest.raises(EngineError, match="has solution"):
+        eng.retract_task(USER, tid)
+
+
+# -- validator lifecycle ---------------------------------------------------
+
+def test_validator_minimum_gates_below_supply_threshold():
+    """Below 1000 supply the minimum is 0 — anyone can solve; above it,
+    0.08% of supply is required (EngineV1.sol:398-404)."""
+    eng, _ = make_engine(seed_engine=590_000 * WAD)  # supply = 10_000
+    assert eng.get_validator_minimum() == 10_000 * WAD * 8 // 10000
+    _, tid = bootstrap_task(eng)
+    cid = b"\x12\x20" + b"\xaa" * 32
+    eng.signal_commitment(V1, eng.generate_commitment(V1, tid, cid))
+    eng.mine_block()
+    with pytest.raises(EngineError, match="min staked too low"):
+        eng.submit_solution(V1, tid, cid)
+    eng.validator_deposit(V1, V1, 8 * WAD)  # exactly the minimum
+    eng.submit_solution(V1, tid, cid)
+
+
+def test_withdraw_two_step():
+    eng, tok = make_engine(validators=(V1,))
+    count = eng.initiate_validator_withdraw(V1, 40 * WAD)
+    with pytest.raises(EngineError, match="wait longer"):
+        eng.validator_withdraw(V1, count, V1)
+    eng.advance_time(86400)
+    bal0 = tok.balance_of(V1)
+    eng.validator_withdraw(V1, count, V1)
+    assert tok.balance_of(V1) - bal0 == 40 * WAD
+    assert eng.validators[V1].staked == 60 * WAD
+
+
+def test_withdraw_pending_counts_against_usable_stake():
+    eng, _ = make_engine(seed_engine=590_000 * WAD)
+    minimum = eng.get_validator_minimum()
+    eng.validator_deposit(V1, V1, minimum)
+    eng.initiate_validator_withdraw(V1, minimum)
+    _, tid = bootstrap_task(eng)
+    cid = b"\x12\x20" + b"\xaa" * 32
+    eng.signal_commitment(V1, eng.generate_commitment(V1, tid, cid))
+    eng.mine_block()
+    with pytest.raises(EngineError, match="min staked too low"):
+        eng.submit_solution(V1, tid, cid)
+
+
+def test_withdraw_cancel():
+    eng, _ = make_engine(validators=(V1,))
+    count = eng.initiate_validator_withdraw(V1, 40 * WAD)
+    eng.cancel_validator_withdraw(V1, count)
+    assert eng.withdraw_pending[V1] == 0
+    with pytest.raises(EngineError, match="request not exist"):
+        eng.validator_withdraw(V1, count, V1)
+
+
+# -- contestations ---------------------------------------------------------
+
+def contest_setup(n_extra_voters=0, *, seed_engine=597_000 * WAD):
+    """Engine above the slashing threshold even after validator deposits
+    push its balance back up (supply ≥ 2000 ⇒ slash > 0)."""
+    eng, tok = make_engine(seed_engine=seed_engine,
+                           validators=(V1, V2, V3, V4)[:2 + n_extra_voters])
+    _, tid = bootstrap_task(eng)
+    solve(eng, tid, V1)
+    return eng, tok, tid
+
+
+def test_contestation_auto_votes_and_escrow():
+    eng, _, tid = contest_setup()
+    slash = eng.get_slash_amount()
+    assert slash > 0
+    s1, s2 = eng.validators[V1].staked, eng.validators[V2].staked
+    eng.submit_contestation(V2, tid)
+    # contester auto-yea, accused auto-nay, both escrowed
+    assert eng.contestation_yeas[tid] == [V2]
+    assert eng.contestation_nays[tid] == [V1]
+    assert eng.validators[V2].staked == s2 - slash
+    assert eng.validators[V1].staked == s1 - slash
+
+
+def test_contestation_too_late():
+    eng, _, tid = contest_setup()
+    eng.advance_time(2000)
+    with pytest.raises(EngineError, match="too late"):
+        eng.submit_contestation(V2, tid)
+
+
+def test_contestation_tie_sides_with_nays():
+    """1 yea vs 1 nay ⇒ solution stands; both refunded, accused gets the
+    yea escrow (single-nay branch, EngineV1.sol:1077-1095)."""
+    eng, tok, tid = contest_setup()
+    slash = eng.get_slash_amount()
+    eng.submit_contestation(V2, tid)
+    eng.advance_time(4000)
+    v1_staked = eng.validators[V1].staked
+    v1_bal = tok.balance_of(V1)
+    eng.contestation_vote_finish(USER, tid, 10)
+    assert eng.validators[V1].staked == v1_staked + slash   # refund
+    assert tok.balance_of(V1) - v1_bal == slash             # yea escrow won
+    # claim path ran inside finish — solution marked claimed is NOT set by
+    # finish (claimed flag only set by claimSolution), but fees flowed:
+    assert tid in eng.solutions
+
+
+def test_contestation_success_refunds_task_fee():
+    """2 yeas vs 1 nay ⇒ contestation wins: task fee back to owner, yeas
+    split the nay's escrow (originator half)."""
+    eng, tok = make_engine(seed_engine=597_000 * WAD,
+                           validators=(V1, V2, V3))
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, 0, TEMPLATE)
+    tid = eng.submit_task(USER, 0, USER, mid, 5 * WAD, b"in")
+    solve(eng, tid, V1)
+    slash = eng.get_slash_amount()
+    eng.submit_contestation(V2, tid)
+    eng.vote_on_contestation(V3, tid, True)
+    eng.advance_time(4000)
+    user0 = tok.balance_of(USER)
+    v2_0, v3_0 = tok.balance_of(V2), tok.balance_of(V3)
+    v2_s, v3_s = eng.validators[V2].staked, eng.validators[V3].staked
+    eng.contestation_vote_finish(USER, tid, 10)
+    assert tok.balance_of(USER) - user0 == 5 * WAD          # fee refund
+    total = slash  # one nay escrowed
+    to_originator = total - total // 2
+    assert tok.balance_of(V2) - v2_0 == to_originator
+    assert tok.balance_of(V3) - v3_0 == total - to_originator
+    assert eng.validators[V2].staked == v2_s + slash
+    assert eng.validators[V3].staked == v3_s + slash
+
+
+def test_contestation_failure_pays_solver():
+    """1 yea vs 2 nays ⇒ solution stands; solver paid via the claim path
+    inside finish; nays split the yea escrow (accused half)."""
+    eng, tok = make_engine(seed_engine=597_000 * WAD,
+                           validators=(V1, V2, V3))
+    mid = eng.register_model(DEPLOYER, MODEL_ADDR, 0, TEMPLATE)
+    tid = eng.submit_task(USER, 0, USER, mid, 10 * WAD, b"in")
+    solve(eng, tid, V1)
+    slash = eng.get_slash_amount()
+    eng.submit_contestation(V2, tid)
+    eng.vote_on_contestation(V3, tid, False)
+    eng.advance_time(4000)
+    v1_0, v3_0 = tok.balance_of(V1), tok.balance_of(V3)
+    eng.contestation_vote_finish(USER, tid, 10)
+    total = slash  # one yea escrowed
+    to_accused = total // 2
+    # V1 (nay index 0) gets accused split + solver fee share (9 of 10)
+    assert tok.balance_of(V1) - v1_0 == to_accused + 9 * WAD
+    assert tok.balance_of(V3) - v3_0 == total - to_accused
+    assert eng.accrued_fees == 1 * WAD
+
+
+def test_contestation_paginated_finish():
+    eng, tok = make_engine(seed_engine=597_000 * WAD,
+                           validators=(V1, V2, V3, V4))
+    _, tid = bootstrap_task(eng)
+    solve(eng, tid, V1)
+    eng.submit_contestation(V2, tid)
+    eng.vote_on_contestation(V3, tid, True)
+    eng.vote_on_contestation(V4, tid, True)
+    eng.advance_time(4000)
+    eng.contestation_vote_finish(USER, tid, 1)   # originator only
+    assert eng.contestations[tid].finish_start_index == 1
+    eng.contestation_vote_finish(USER, tid, 2)   # the rest
+    assert eng.contestations[tid].finish_start_index == 3
+    slash = eng.contestations[tid].slash_amount
+    assert eng.validators[V3].staked == 100 * WAD  # escrow refunded
+
+
+def test_contestation_below_slash_threshold_is_zero_stakes():
+    """Below 2000 supply getSlashAmount()=0: contestations escrow nothing
+    (base.test.ts pre-threshold matrix)."""
+    eng, _, tid = contest_setup(seed_engine=599_500 * WAD)  # supply 500
+    assert eng.get_slash_amount() == 0
+    s1 = eng.validators[V1].staked
+    eng.submit_contestation(V2, tid)
+    assert eng.validators[V1].staked == s1
+
+
+def test_stake_age_gate_blocks_new_validators():
+    """A validator staked after the contestation started cannot vote
+    (vote-buying defense, EngineV1.sol:976-981)."""
+    eng, _, tid = contest_setup(1)
+    eng.submit_contestation(V2, tid)
+    eng.advance_time(500)
+    eng.validator_deposit(V4, V4, 100 * WAD)  # staked AFTER contestation
+    assert eng.validator_can_vote(V4, tid) == 0x06
+    with pytest.raises(EngineError, match="not allowed"):
+        eng.vote_on_contestation(V4, tid, True)
+    # V3 staked before: allowed
+    assert eng.validator_can_vote(V3, tid) == 0
+
+
+def test_validator_can_vote_codes():
+    eng, _, tid = contest_setup(1)
+    assert eng.validator_can_vote(V3, b"\x00" * 32) == 0x01  # no contestation
+    eng.submit_contestation(V2, tid)
+    assert eng.validator_can_vote(V2, tid) == 0x03           # already voted
+    assert eng.validator_can_vote(USER, tid) == 0x04         # never staked
+    eng.vote_on_contestation(V3, tid, True)
+    eng.advance_time(4001)
+    assert eng.validator_can_vote(V3, tid) == 0x02           # period over
+
+
+def test_claim_blocked_by_contestation():
+    eng, _, tid = contest_setup()
+    eng.submit_contestation(V2, tid)
+    eng.advance_time(2001)
+    with pytest.raises(EngineError, match="has contestation"):
+        eng.claim_solution(USER, tid)
+
+
+# -- pause gates -----------------------------------------------------------
+
+def test_pause_gates_entry_points():
+    eng, _ = make_engine(validators=(V1,))
+    _, tid = bootstrap_task(eng)
+    eng.set_paused(True)
+    for call in [
+        lambda: eng.submit_task(USER, 0, USER, b"\x01" * 32, 0, b"x"),
+        lambda: eng.signal_commitment(V1, b"\x02" * 32),
+        lambda: eng.submit_solution(V1, tid, b"\x03"),
+        lambda: eng.register_model(DEPLOYER, MODEL_ADDR, 0, b"t"),
+        lambda: eng.validator_deposit(V1, V1, WAD),
+        lambda: eng.claim_solution(USER, tid),
+        lambda: eng.submit_contestation(V1, tid),
+        lambda: eng.retract_task(USER, tid),
+    ]:
+        with pytest.raises(EngineError, match="paused"):
+            call()
+    eng.set_paused(False)
+    solve(eng, tid)  # works again
+
+
+# -- events ----------------------------------------------------------------
+
+def test_events_stream_to_subscribers():
+    eng, _ = make_engine(validators=(V1,))
+    seen = []
+    eng.subscribe(lambda ev: seen.append(ev.name))
+    _, tid = bootstrap_task(eng)
+    solve(eng, tid)
+    assert "TaskSubmitted" in seen
+    assert "SignalCommitment" in seen
+    assert "SolutionSubmitted" in seen
