@@ -159,6 +159,13 @@ class SD15Pipeline:
         return fn
 
     # -- public API ------------------------------------------------------
+    def compiled_bucket(self, batch: int, height: int, width: int,
+                        steps: int, scheduler: str):
+        """Public handle on a bucket executable: the jittable solve-step fn
+        with signature (params, ids_cond, ids_uncond, guidance, seeds_lo,
+        seeds_hi) -> uint8 images. Contract for external drivers."""
+        return self._bucket_fn(batch, height, width, steps, scheduler)
+
     def generate(
         self,
         params: dict,

@@ -1,0 +1,33 @@
+"""Miner node (L3'): event loop, job queue, solver pipeline, stake manager.
+
+The reference's miner process (`miner/src/`) re-architected around
+in-process TPU inference: no cog container, no IPFS daemon — runners
+produce bytes, codecs pin them, L0 computes the CID the node commits.
+"""
+from arbius_tpu.node.chain_client import LocalChain
+from arbius_tpu.node.config import (
+    AutomineConfig,
+    ConfigError,
+    MiningConfig,
+    ModelConfig,
+    StakeConfig,
+    load_config,
+)
+from arbius_tpu.node.db import Job, NodeDB
+from arbius_tpu.node.node import BootError, MinerNode, NodeMetrics
+from arbius_tpu.node.retry import RetriesExhausted, expretry
+from arbius_tpu.node.solver import (
+    ModelRegistry,
+    RegisteredModel,
+    SD15Runner,
+    solve_cid,
+    solve_files,
+)
+
+__all__ = [
+    "AutomineConfig", "BootError", "ConfigError", "Job", "LocalChain",
+    "MinerNode", "MiningConfig", "ModelConfig", "ModelRegistry",
+    "NodeDB", "NodeMetrics", "RegisteredModel", "RetriesExhausted",
+    "SD15Runner", "StakeConfig", "expretry", "load_config", "solve_cid",
+    "solve_files",
+]

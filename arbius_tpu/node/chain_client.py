@@ -1,0 +1,126 @@
+"""Chain client facade — the node's only window onto the protocol.
+
+One interface, two backends: `LocalChain` wraps the in-process Engine
+(tests, local mining); a JSON-RPC backend can implement the same surface
+against Arbitrum later (`miner/src/blockchain.ts:22-36` equivalent). The
+node never imports Engine directly, so the seam is explicit and narrow.
+
+Hex-string convention at this boundary: task/model ids and CIDs cross as
+0x-hex strings (what event logs and JSON carry); the facade converts to
+the engine's bytes domain.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from arbius_tpu.chain import Engine, EngineError
+
+
+def _b(hexstr: str) -> bytes:
+    return bytes.fromhex(hexstr[2:] if hexstr.startswith("0x") else hexstr)
+
+
+def _h(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+class LocalChain:
+    """The engine as seen by one wallet (`sender`)."""
+
+    def __init__(self, engine: Engine, sender: str):
+        self.engine = engine
+        self.address = sender.lower()
+
+    # -- chain state -----------------------------------------------------
+    @property
+    def now(self) -> int:
+        return self.engine.now
+
+    def version(self) -> int:
+        return self.engine.version
+
+    def subscribe(self, fn: Callable) -> None:
+        self.engine.subscribe(fn)
+
+    def get_task(self, taskid: str):
+        return self.engine.tasks.get(_b(taskid))
+
+    def get_task_input_bytes(self, taskid: str) -> bytes | None:
+        return self.engine.task_input_data.get(_b(taskid))
+
+    def get_solution(self, taskid: str):
+        return self.engine.solutions.get(_b(taskid))
+
+    def get_contestation(self, taskid: str):
+        return self.engine.contestations.get(_b(taskid))
+
+    def validator_staked(self) -> int:
+        v = self.engine.validators.get(self.address)
+        return v.staked if v else 0
+
+    def validator_withdraw_pending(self) -> int:
+        return self.engine.withdraw_pending.get(self.address, 0)
+
+    def get_validator_minimum(self) -> int:
+        return self.engine.get_validator_minimum()
+
+    def min_claim_solution_time(self) -> int:
+        return self.engine.min_claim_solution_time
+
+    def token_balance(self) -> int:
+        return self.engine.token.balance_of(self.address)
+
+    def validator_can_vote(self, taskid: str) -> int:
+        return self.engine.validator_can_vote(self.address, _b(taskid))
+
+    def contestation_voted(self, taskid: str) -> bool:
+        return self.address in self.engine.contestation_voted.get(
+            _b(taskid), set())
+
+    # -- transactions ----------------------------------------------------
+    # Each tx mines a block afterward (hardhat-automine style): on the real
+    # chain a commit tx always lands in an earlier block than the reveal,
+    # which the engine's "commitment must be in past" check requires.
+    def _tx(self, fn):
+        result = fn()
+        self.engine.mine_block()
+        return result
+
+    def submit_task(self, version: int, owner: str, model: str, fee: int,
+                    input_: bytes) -> str:
+        return _h(self._tx(lambda: self.engine.submit_task(
+            self.address, version, owner, _b(model), fee, input_)))
+
+    def signal_commitment(self, commitment: bytes) -> None:
+        self._tx(lambda: self.engine.signal_commitment(
+            self.address, commitment))
+
+    def submit_solution(self, taskid: str, cid: str) -> None:
+        self._tx(lambda: self.engine.submit_solution(
+            self.address, _b(taskid), _b(cid)))
+
+    def claim_solution(self, taskid: str) -> None:
+        self._tx(lambda: self.engine.claim_solution(self.address, _b(taskid)))
+
+    def submit_contestation(self, taskid: str) -> None:
+        self._tx(lambda: self.engine.submit_contestation(
+            self.address, _b(taskid)))
+
+    def vote_on_contestation(self, taskid: str, yea: bool) -> None:
+        self._tx(lambda: self.engine.vote_on_contestation(
+            self.address, _b(taskid), yea))
+
+    def contestation_vote_finish(self, taskid: str, amnt: int) -> None:
+        self._tx(lambda: self.engine.contestation_vote_finish(
+            self.address, _b(taskid), amnt))
+
+    def validator_deposit(self, amount: int) -> None:
+        self._tx(lambda: self.engine.validator_deposit(
+            self.address, self.address, amount))
+
+    def generate_commitment(self, taskid: str, cid: str) -> bytes:
+        return self.engine.generate_commitment(self.address, _b(taskid),
+                                               _b(cid))
+
+
+__all__ = ["LocalChain", "EngineError"]

@@ -1,0 +1,87 @@
+"""Two-tier node configuration (SURVEY.md §5 config system).
+
+Tier 1 (deployment constants): chain addresses and model ids — the
+reference bakes these into `miner/src/config.json:1-24`.
+Tier 2 (operator config): what the reference's `MiningConfig.json`
+holds (`miner/src/types.ts:3-54`) — enabled models with filters,
+stake buffers, automine, RPC port, db path. Parsed + schema-validated
+up front (the reference only JSON-parses, start.ts:12-18; we reject
+unknown keys and wrong types at boot instead of failing mid-mine).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    id: str                       # 0x model hash
+    template: str                 # template name (e.g. "anythingv3")
+    enabled: bool = True
+    min_fee: int = 0              # wad; checkModelFilter mirror
+    allowed_owners: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class AutomineConfig:
+    enabled: bool = False
+    version: int = 0
+    model: str = ""
+    fee: int = 0
+    input: dict = field(default_factory=dict)
+    delay: int = 60               # seconds between self-submitted tasks
+
+
+@dataclass(frozen=True)
+class StakeConfig:
+    """Auto top-up thresholds (index.ts:411-472): keep staked above
+    minimum*(1+buffer_min); when topping up, target minimum*(1+buffer)."""
+    check_interval: int = 600
+    buffer_min_percent: float = 0.01
+    buffer_percent: float = 0.20
+
+
+@dataclass(frozen=True)
+class MiningConfig:
+    db_path: str = ":memory:"
+    log_path: str | None = None
+    evilmode: bool = False        # fault injection: commit wrong CIDs
+    models: tuple[ModelConfig, ...] = ()
+    automine: AutomineConfig = AutomineConfig()
+    stake: StakeConfig = StakeConfig()
+    claim_delay_buffer: int = 120  # claim at solution+minClaimTime+this
+    poll_interval_ms: int = 100    # main-loop cadence (index.ts:1082-1096)
+
+
+_KNOWN = {f for f in MiningConfig.__dataclass_fields__}
+
+
+def load_config(raw: str | dict) -> MiningConfig:
+    obj = json.loads(raw) if isinstance(raw, str) else dict(raw)
+    unknown = set(obj) - _KNOWN
+    if unknown:
+        raise ConfigError(f"unknown config keys: {sorted(unknown)}")
+    def build(cls, kwargs, where):
+        try:
+            return cls(**kwargs)
+        except TypeError as e:
+            raise ConfigError(f"{where}: {e}") from None
+
+    models = []
+    for m in obj.pop("models", []):
+        m = dict(m)
+        if "id" not in m or "template" not in m:
+            raise ConfigError("model entry needs id and template")
+        owners = tuple(a.lower() for a in m.pop("allowed_owners", []))
+        models.append(build(ModelConfig,
+                            dict(allowed_owners=owners, **m), "models"))
+    automine = build(AutomineConfig, obj.pop("automine", {}), "automine")
+    stake = build(StakeConfig, obj.pop("stake", {}), "stake")
+    return build(MiningConfig,
+                 dict(models=tuple(models), automine=automine, stake=stake,
+                      **obj), "config")

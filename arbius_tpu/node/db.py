@@ -1,0 +1,205 @@
+"""Node persistence: sqlite-backed job queue + protocol-state cache.
+
+The store IS the checkpoint (SURVEY.md §5): jobs, tasks, inputs, solutions
+survive restarts; re-scheduling job types are cleared at boot by the node.
+Schema follows the reference's eight tables (`miner/src/db.ts:24-52`,
+`miner/src/sql/*.sql`) with the same queue semantics:
+
+  - jobs ordered by priority DESC, gated on waituntil <= now
+    (`db.ts:131-144`)
+  - task rows cache chain state; INSERT OR IGNORE dedupes replayed events
+    (`db.ts:157`)
+  - the per-task seed is derived, not stored — re-injected on read
+    (`db.ts:107-110`) so a corrupted row can never change determinism
+
+`:memory:` works for tests; a path gives durability.
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from dataclasses import dataclass
+
+from arbius_tpu.l0.commitment import taskid2seed
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS tasks (
+    id TEXT PRIMARY KEY, modelid TEXT, fee TEXT, address TEXT,
+    blocktime TEXT, version INT, cid TEXT, retracted BOOLEAN DEFAULT FALSE);
+CREATE TABLE IF NOT EXISTS task_inputs (
+    taskid TEXT PRIMARY KEY, cid TEXT, data TEXT);
+CREATE TABLE IF NOT EXISTS solutions (
+    taskid TEXT PRIMARY KEY, validator TEXT, blocktime TEXT,
+    claimed BOOLEAN, cid TEXT);
+CREATE TABLE IF NOT EXISTS contestations (
+    taskid TEXT PRIMARY KEY, validator TEXT, blocktime TEXT,
+    finish_start_index INT);
+CREATE TABLE IF NOT EXISTS contestation_votes (
+    taskid TEXT, validator TEXT, yea BOOLEAN,
+    PRIMARY KEY (taskid, validator));
+CREATE TABLE IF NOT EXISTS invalid_tasks (
+    taskid TEXT PRIMARY KEY);
+CREATE TABLE IF NOT EXISTS jobs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT, priority INTEGER,
+    waituntil INTEGER, concurrent BOOLEAN, method TEXT, data TEXT);
+CREATE TABLE IF NOT EXISTS failed_jobs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT, method TEXT, data TEXT);
+CREATE INDEX IF NOT EXISTS jobs_priority ON jobs(priority);
+"""
+
+
+@dataclass
+class Job:
+    id: int
+    priority: int
+    waituntil: int
+    concurrent: bool
+    method: str
+    data: dict
+
+
+class NodeDB:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+
+    def close(self):
+        self._conn.close()
+
+    # -- jobs (priority queue, db.ts:131-144 / :237-267) -----------------
+    def queue_job(self, method: str, data: dict, *, priority: int = 0,
+                  waituntil: int = 0, concurrent: bool = False) -> int:
+        with self._lock:
+            cur = self._conn.execute(
+                "INSERT INTO jobs (priority, waituntil, concurrent, method,"
+                " data) VALUES (?,?,?,?,?)",
+                (priority, waituntil, int(concurrent), method,
+                 json.dumps(data, sort_keys=True)))
+            self._conn.commit()
+            return cur.lastrowid
+
+    def get_jobs(self, now: int, limit: int = 100) -> list[Job]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT * FROM jobs WHERE waituntil <= ? "
+                "ORDER BY priority DESC, id ASC LIMIT ?", (now, limit))
+            return [Job(r["id"], r["priority"], r["waituntil"],
+                        bool(r["concurrent"]), r["method"],
+                        json.loads(r["data"])) for r in rows]
+
+    def delete_job(self, job_id: int) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM jobs WHERE id = ?", (job_id,))
+            self._conn.commit()
+
+    def clear_jobs_by_method(self, method: str) -> None:
+        """Boot-time dedupe of self-rescheduling jobs (index.ts:977-979)."""
+        with self._lock:
+            self._conn.execute("DELETE FROM jobs WHERE method = ?", (method,))
+            self._conn.commit()
+
+    def fail_job(self, job: Job) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO failed_jobs (method, data) VALUES (?,?)",
+                (job.method, json.dumps(job.data, sort_keys=True)))
+            self._conn.execute("DELETE FROM jobs WHERE id = ?", (job.id,))
+            self._conn.commit()
+
+    def failed_jobs(self) -> list[tuple[str, dict]]:
+        with self._lock:
+            rows = self._conn.execute("SELECT method, data FROM failed_jobs")
+            return [(r["method"], json.loads(r["data"])) for r in rows]
+
+    def job_count(self) -> int:
+        with self._lock:
+            return self._conn.execute("SELECT COUNT(*) c FROM jobs"
+                                      ).fetchone()["c"]
+
+    # -- task cache ------------------------------------------------------
+    def store_task(self, taskid: str, modelid: str, fee: int, address: str,
+                   blocktime: int, version: int, cid: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO tasks (id, modelid, fee, address,"
+                " blocktime, version, cid) VALUES (?,?,?,?,?,?,?)",
+                (taskid, modelid, str(fee), address, str(blocktime),
+                 version, cid))
+            self._conn.commit()
+
+    def get_task(self, taskid: str) -> sqlite3.Row | None:
+        with self._lock:
+            return self._conn.execute("SELECT * FROM tasks WHERE id = ?",
+                                      (taskid,)).fetchone()
+
+    def store_task_input(self, taskid: str, cid: str, data: dict) -> None:
+        stored = {k: v for k, v in data.items() if k != "seed"}
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO task_inputs (taskid, cid, data)"
+                " VALUES (?,?,?)",
+                (taskid, cid, json.dumps(stored, sort_keys=True)))
+            self._conn.commit()
+
+    def get_task_input(self, taskid: str) -> dict | None:
+        """Seed is always re-derived from the taskid on read (db.ts:107-110):
+        the determinism root can't be corrupted by a bad row."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT data FROM task_inputs WHERE taskid = ?",
+                (taskid,)).fetchone()
+        if row is None:
+            return None
+        data = json.loads(row["data"])
+        data["seed"] = taskid2seed(taskid)
+        return data
+
+    # -- solutions / contestations / invalid tasks -----------------------
+    def store_solution(self, taskid: str, validator: str, blocktime: int,
+                       claimed: bool, cid: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO solutions (taskid, validator,"
+                " blocktime, claimed, cid) VALUES (?,?,?,?,?)",
+                (taskid, validator, str(blocktime), int(claimed), cid))
+            self._conn.commit()
+
+    def get_solution(self, taskid: str) -> sqlite3.Row | None:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT * FROM solutions WHERE taskid = ?",
+                (taskid,)).fetchone()
+
+    def mark_invalid_task(self, taskid: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO invalid_tasks (taskid) VALUES (?)",
+                (taskid,))
+            self._conn.commit()
+
+    def is_invalid_task(self, taskid: str) -> bool:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT 1 FROM invalid_tasks WHERE taskid = ?",
+                (taskid,)).fetchone() is not None
+
+    def store_contestation(self, taskid: str, validator: str,
+                           blocktime: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO contestations (taskid, validator,"
+                " blocktime, finish_start_index) VALUES (?,?,?,0)",
+                (taskid, validator, str(blocktime)))
+            self._conn.commit()
+
+    def store_vote(self, taskid: str, validator: str, yea: bool) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO contestation_votes (taskid,"
+                " validator, yea) VALUES (?,?,?)", (taskid, validator,
+                                                    int(yea)))
+            self._conn.commit()

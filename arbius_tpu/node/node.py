@@ -1,0 +1,381 @@
+"""MinerNode — the event loop, job processors, and solver pipeline (L3').
+
+Mirror of `miner/src/index.ts` restructured for in-process TPU inference:
+chain events enqueue jobs in sqlite; `tick()` drains due jobs in the
+reference's two-phase order (concurrent batch, then serial); the solve
+path replaces the cog-HTTP hop with registry runners and — the TPU win —
+groups compatible solve jobs into one dp-batched XLA dispatch.
+
+Reference call-stack parity (SURVEY.md §3):
+  boot self-test golden CID        index.ts:984-1001 → boot()
+  event → task job                 index.ts:191-201  → _on_task_submitted
+  processTask (filter+hydrate)     index.ts:506-564  → _process_task
+  processSolve (cid→commit→reveal) index.ts:566-672  → _process_solve_batch
+  contest-on-mismatch              index.ts:651-670  → same
+  processClaim                     index.ts:728-750  → _process_claim
+  stake auto-top-up                index.ts:397-472  → _process_validator_stake
+  automine                         index.ts:474-503  → _process_automine
+  vote-if-invalid                  index.ts:268-306  → _on_contestation
+
+Time/blocks come from the chain facade — no wall clock — so tests drive
+the node deterministically.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+
+from arbius_tpu.l0.commitment import taskid2seed
+from arbius_tpu.node.chain_client import EngineError, LocalChain
+from arbius_tpu.node.config import MiningConfig
+from arbius_tpu.node.db import Job, NodeDB
+from arbius_tpu.node.retry import RetriesExhausted, expretry
+from arbius_tpu.node.solver import ModelRegistry, solve_cid, solve_cid_batch
+from arbius_tpu.templates.engine import (
+    HydrationError,
+    MiningFilter,
+    check_model_filter,
+    hydrate_input,
+)
+
+log = logging.getLogger("arbius.node")
+
+MINER_VERSION = 0  # versionCheck: chain version must be <= ours
+
+
+@dataclass
+class NodeMetrics:
+    solutions_submitted: int = 0
+    solutions_claimed: int = 0
+    contestations_submitted: int = 0
+    votes_cast: int = 0
+    tasks_seen: int = 0
+    tasks_invalid: int = 0
+    solve_latency: list = field(default_factory=list)  # (taskid, seconds)
+
+
+class BootError(RuntimeError):
+    pass
+
+
+class MinerNode:
+    def __init__(self, chain: LocalChain, config: MiningConfig,
+                 registry: ModelRegistry, db: NodeDB | None = None):
+        self.chain = chain
+        self.config = config
+        self.registry = registry
+        self.db = db or NodeDB(config.db_path)
+        self.metrics = NodeMetrics()
+        self._retry_sleep = lambda s: None  # injectable; chain time is fake
+
+    # -- boot (start.ts:11-52 + index.ts:971-1020) -----------------------
+    def boot(self, *, skip_self_test: bool = False) -> None:
+        self.db.clear_jobs_by_method("validatorStake")
+        self.db.clear_jobs_by_method("automine")
+        if self.chain.version() > MINER_VERSION:
+            raise BootError(
+                f"chain version {self.chain.version()} > miner {MINER_VERSION}"
+                " — update the node (index.ts:960-969)")
+        if not skip_self_test:
+            self._boot_self_test()
+        self.db.queue_job("validatorStake", {}, priority=100)
+        if self.config.automine.enabled:
+            self.db.queue_job("automine", {}, priority=10)
+        self.chain.subscribe(self._on_event)
+        log.info("node booted: %d models, address %s",
+                 len(self.registry.ids()), self.chain.address)
+
+    def _boot_self_test(self) -> None:
+        """Golden-CID reproducibility check before mining anything
+        (index.ts:984-1001): nondeterministic hardware must fail loudly
+        at boot, not via slashing."""
+        for mid in self.registry.ids():
+            m = self.registry.get(mid)
+            if m.golden is None:
+                continue
+            inp, seed, expected = m.golden
+            hydrated = hydrate_input(dict(inp), m.template)
+            got, _ = solve_cid(m, hydrated, seed)
+            if got.lower() != expected.lower():
+                raise BootError(
+                    f"boot self-test failed for {mid}: got {got}, "
+                    f"expected {expected} — nondeterministic build/hardware")
+
+    # -- event handlers ---------------------------------------------------
+    def _on_event(self, ev) -> None:
+        name = ev.name
+        if name == "TaskSubmitted":
+            self._on_task_submitted(ev.args)
+        elif name == "SolutionSubmitted":
+            self._on_solution_submitted(ev.args)
+        elif name == "ContestationSubmitted":
+            self._on_contestation(ev.args)
+        elif name == "ContestationVote":
+            self.db.store_vote("0x" + ev.args["task"].hex(),
+                               ev.args["addr"], ev.args["yea"])
+        elif name == "VersionChanged":
+            if ev.args["version"] > MINER_VERSION:
+                log.error("chain version now %d > miner %d — stop mining",
+                          ev.args["version"], MINER_VERSION)
+
+    def _on_task_submitted(self, args: dict) -> None:
+        taskid = "0x" + args["id"].hex()
+        model = "0x" + args["model"].hex()
+        self.metrics.tasks_seen += 1
+        if self.registry.get(model) is None:
+            return
+        self.db.store_task(taskid, model, args["fee"], args["sender"],
+                           self.chain.now, 0, "")
+        self.db.queue_job("task", {"taskid": taskid}, concurrent=True)
+
+    def _on_solution_submitted(self, args: dict) -> None:
+        taskid = "0x" + args["task"].hex()
+        sol = self.chain.get_solution(taskid)
+        if sol is not None:
+            self.db.store_solution(taskid, sol.validator, sol.blocktime,
+                                   sol.claimed, "0x" + sol.cid.hex())
+        # solution for a task we proved invalid → contest (index.ts:236-266)
+        if args["addr"] != self.chain.address and \
+                self.db.is_invalid_task(taskid):
+            self.db.queue_job("contest", {"taskid": taskid}, priority=50)
+
+    def _on_contestation(self, args: dict) -> None:
+        taskid = "0x" + args["task"].hex()
+        self.db.store_contestation(taskid, args["addr"], self.chain.now)
+        if args["addr"] == self.chain.address:
+            return
+        if self.db.is_invalid_task(taskid):
+            self.db.queue_job("vote", {"taskid": taskid, "yea": True},
+                              priority=50)
+
+    # -- job processing (two-phase, index.ts:879-958) ---------------------
+    def run(self, *, stop: "callable | None" = None) -> None:
+        """Production loop: poll the queue at poll_interval_ms
+        (index.ts:1078-1101). `stop()` → True ends the loop (tests/SIGTERM
+        handlers); chain time drives job due-ness, wall time drives cadence."""
+        import time as _time
+
+        while not (stop and stop()):
+            self.tick()
+            _time.sleep(self.config.poll_interval_ms / 1000.0)
+
+    def tick(self) -> int:
+        """One poll: run due concurrent jobs, then one serial pass.
+        Returns number of jobs processed."""
+        jobs = self.db.get_jobs(self.chain.now)
+        if not jobs:
+            return 0
+        done = 0
+        concurrent = [j for j in jobs if j.concurrent]
+        serial = [j for j in jobs if not j.concurrent]
+        for job in concurrent:
+            done += self._run_job(job)
+        # dp batching: group due solve jobs into one XLA dispatch
+        solves = [j for j in serial if j.method == "solve"]
+        others = [j for j in serial if j.method != "solve"]
+        if solves:
+            done += self._process_solve_batch(solves)
+        for job in others:
+            done += self._run_job(job)
+        return done
+
+    def _run_job(self, job: Job) -> int:
+        try:
+            handler = {
+                "task": self._process_task,
+                "claim": self._process_claim,
+                "contest": self._process_contest,
+                "vote": self._process_vote,
+                "validatorStake": self._process_validator_stake,
+                "automine": self._process_automine,
+                "pinTaskInput": lambda d: None,  # input mirroring: no-op
+            }.get(job.method)
+            if handler is None:
+                log.error("unknown job method %s", job.method)
+                self.db.fail_job(job)
+                return 0
+            handler(job.data)
+            self.db.delete_job(job.id)
+            return 1
+        except Exception as e:  # noqa: BLE001 — failed_jobs quarantine
+            log.warning("job %s failed: %r", job.method, e)
+            self.db.fail_job(job)
+            return 0
+
+    # -- processors -------------------------------------------------------
+    def _process_task(self, data: dict) -> None:
+        """Validate + hydrate + queue solve (index.ts:506-564)."""
+        taskid = data["taskid"]
+        task = self.chain.get_task(taskid)
+        if task is None:
+            raise ValueError(f"task {taskid} not on chain")
+        if task.version != 0:
+            self.db.mark_invalid_task(taskid)
+            self.metrics.tasks_invalid += 1
+            return
+        model_id = "0x" + task.model.hex()
+        m = self.registry.get(model_id)
+        if m is None:
+            return
+        filters = [MiningFilter(minfee=m.min_fee, owner=o)
+                   for o in m.allowed_owners] or \
+                  [MiningFilter(minfee=m.min_fee)]
+        result = check_model_filter(
+            {model_id: (m.template, filters)}, model=model_id,
+            now=self.chain.now, fee=task.fee, blocktime=task.blocktime,
+            owner=task.owner)
+        if not result.filter_passed:
+            return
+        raw = self.chain.get_task_input_bytes(taskid)
+        if raw is None:
+            raise ValueError(f"no input bytes for {taskid}")
+        try:
+            obj = json.loads(raw.decode("utf-8"))
+            hydrated = hydrate_input(obj, m.template)
+        except (ValueError, HydrationError) as e:
+            # invalid input: remember, so any solution gets contested
+            log.info("task %s invalid input: %r", taskid, e)
+            self.db.mark_invalid_task(taskid)
+            self.metrics.tasks_invalid += 1
+            return
+        hydrated["seed"] = taskid2seed(taskid)
+        self.db.store_task_input(taskid, "", hydrated)
+        self.db.queue_job("solve", {"taskid": taskid, "model": model_id},
+                          concurrent=False)
+
+    def _bucket_key(self, model_id: str, hydrated: dict) -> tuple:
+        return (model_id, hydrated.get("width"), hydrated.get("height"),
+                hydrated.get("num_inference_steps"),
+                hydrated.get("scheduler"))
+
+    def _process_solve_batch(self, jobs: list[Job]) -> int:
+        """Group solve jobs by shape bucket and run each bucket as ONE
+        batched dispatch (solve_cid_batch → the runner's dp batch path).
+        Commit/reveal stays per-task (chain semantics)."""
+        by_bucket: dict[tuple, list[tuple[Job, dict]]] = {}
+        for job in jobs:
+            hydrated = self.db.get_task_input(job.data["taskid"])
+            if hydrated is None:
+                self.db.fail_job(job)
+                continue
+            by_bucket.setdefault(
+                self._bucket_key(job.data["model"], hydrated), []).append(
+                (job, hydrated))
+        done = 0
+        for (model_id, *_), entries in by_bucket.items():
+            m = self.registry.get(model_id)
+            t_start = self.chain.now
+            try:
+                results = solve_cid_batch(
+                    m, [(h, h["seed"]) for _, h in entries],
+                    evilmode=self.config.evilmode)
+            except Exception as e:  # noqa: BLE001 — whole bucket failed
+                log.warning("bucket solve failed: %r", e)
+                for job, _ in entries:
+                    self.db.fail_job(job)
+                continue
+            for (job, _), (cid, _files) in zip(entries, results):
+                try:
+                    self._commit_reveal(job.data["taskid"], cid, t_start)
+                    self.db.delete_job(job.id)
+                    done += 1
+                except Exception as e:  # noqa: BLE001
+                    log.warning("solve commit failed: %r", e)
+                    self.db.fail_job(job)
+        return done
+
+    def _commit_reveal(self, taskid: str, cid: str, t_start: int) -> None:
+        """index.ts:566-672: skip if solved (contest on CID mismatch —
+        the reference merely bails, index.ts:568-579; contesting here is
+        strictly more vigilant), else commit → reveal → queue claim."""
+        existing = self.chain.get_solution(taskid)
+        if existing is not None:
+            if "0x" + existing.cid.hex() != cid and \
+                    existing.validator != self.chain.address:
+                self.db.mark_invalid_task(taskid)
+                self.db.queue_job("contest", {"taskid": taskid}, priority=50)
+            return
+        commitment = self.chain.generate_commitment(taskid, cid)
+        try:
+            self.chain.signal_commitment(commitment)
+        except EngineError:
+            pass  # already signalled (e.g. replay); reveal decides
+        try:
+            expretry(lambda: self.chain.submit_solution(taskid, cid),
+                     tries=3, sleep=self._retry_sleep)
+            self.metrics.solutions_submitted += 1
+            self.metrics.solve_latency.append(
+                (taskid, self.chain.now - t_start))
+            self.db.queue_job(
+                "claim", {"taskid": taskid},
+                waituntil=self.chain.now
+                + self.chain.min_claim_solution_time()
+                + self.config.claim_delay_buffer)
+        except RetriesExhausted:
+            sol = self.chain.get_solution(taskid)
+            if sol is not None and "0x" + sol.cid.hex() != cid:
+                # lost the race to a wrong answer → contest
+                self.db.mark_invalid_task(taskid)
+                self.db.queue_job("contest", {"taskid": taskid}, priority=50)
+
+    def _process_claim(self, data: dict) -> None:
+        """index.ts:728-750."""
+        taskid = data["taskid"]
+        if self.chain.get_contestation(taskid) is not None:
+            return  # resolved via contestationVoteFinish instead
+        expretry(lambda: self.chain.claim_solution(taskid),
+                 tries=3, sleep=self._retry_sleep)
+        self.metrics.solutions_claimed += 1
+
+    def _process_contest(self, data: dict) -> None:
+        """index.ts:674-707: contest, or pile onto an existing one."""
+        taskid = data["taskid"]
+        try:
+            self.chain.submit_contestation(taskid)
+            self.metrics.contestations_submitted += 1
+        except EngineError:
+            if not self.chain.contestation_voted(taskid) and \
+                    self.chain.validator_can_vote(taskid) == 0:
+                self.chain.vote_on_contestation(taskid, True)
+                self.metrics.votes_cast += 1
+
+    def _process_vote(self, data: dict) -> None:
+        """index.ts:709-726."""
+        taskid = data["taskid"]
+        if self.chain.contestation_voted(taskid):
+            return
+        if self.chain.validator_can_vote(taskid) != 0:
+            return
+        self.chain.vote_on_contestation(taskid, data["yea"])
+        self.metrics.votes_cast += 1
+
+    def _process_validator_stake(self, data: dict) -> None:
+        """Auto top-up (index.ts:397-472) with the 1%/20% buffers, then
+        re-queue self at +interval."""
+        minimum = self.chain.get_validator_minimum()
+        staked = self.chain.validator_staked() - \
+            self.chain.validator_withdraw_pending()
+        floor = minimum + int(minimum * self.config.stake.buffer_min_percent)
+        if staked < floor:
+            target = minimum + int(minimum * self.config.stake.buffer_percent)
+            need = target - staked
+            if need > 0:
+                if self.chain.token_balance() < need:
+                    log.error("stake top-up needs %d but balance is %d",
+                              need, self.chain.token_balance())
+                else:
+                    self.chain.validator_deposit(need)
+        self.db.queue_job("validatorStake", {}, priority=100,
+                          waituntil=self.chain.now + self.config.stake.check_interval)
+
+    def _process_automine(self, data: dict) -> None:
+        """Self-submitted work (index.ts:474-503)."""
+        a = self.config.automine
+        try:
+            self.chain.submit_task(
+                a.version, self.chain.address, a.model, a.fee,
+                json.dumps(a.input, sort_keys=True).encode())
+        finally:
+            self.db.queue_job("automine", {}, priority=10,
+                              waituntil=self.chain.now + a.delay)

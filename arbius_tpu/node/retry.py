@@ -1,0 +1,34 @@
+"""Exponential-backoff retry — the node's universal failure wrapper.
+
+Mirror of `miner/src/utils.ts:21-39` expretry: every chain/IPFS/inference
+call in the reference is wrapped in it (SURVEY.md §5 failure detection).
+Deterministic (no jitter) so tests can assert retry counts; sleep is
+injectable for the same reason.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class RetriesExhausted(Exception):
+    def __init__(self, attempts: int, last: Exception):
+        super().__init__(f"failed after {attempts} attempts: {last!r}")
+        self.attempts = attempts
+        self.last = last
+
+
+def expretry(fn: Callable[[], T], *, tries: int = 10, base: float = 1.5,
+             sleep: Callable[[float], None] = time.sleep) -> T:
+    """Run fn, retrying with delays base^attempt (utils.ts default 10/1.5)."""
+    last: Exception | None = None
+    for attempt in range(tries):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — mirror reference: retry all
+            last = e
+            if attempt + 1 < tries:
+                sleep(base ** attempt)
+    raise RetriesExhausted(tries, last)

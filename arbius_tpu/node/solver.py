@@ -1,0 +1,138 @@
+"""Model registry + the deterministic solve path (inference → bytes → CID).
+
+The reference's `EnabledModels` maps a model id to a template, filters, and
+a `getfiles` that HTTP-POSTs a cog container (`miner/src/index.ts:781-877`).
+Here `getfiles` IS the framework: an in-process runner produces the output
+arrays, the codec layer fixes their bytes, and the L0 DAG fixes the CID —
+no sidecars (`models.ts:34-54` default__getcid equivalent).
+
+A `Runner` is `(hydrated_input: dict, seed: int) -> dict[filename, bytes]`.
+`SD15Runner` adapts the SD-1.5 pipeline; tests plug in fakes. Runners must
+be deterministic in (input, seed) — `solve_cid` is what gets keccak'd into
+the on-chain commitment.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Protocol
+
+import numpy as np
+
+from arbius_tpu.codecs import encode_png
+from arbius_tpu.l0.cid import cid_hex, cid_of_solution_files
+from arbius_tpu.templates.engine import Template, load_template
+
+Runner = Callable[[dict, int], dict]
+
+
+@dataclass
+class RegisteredModel:
+    id: str                       # 0x hash
+    template: Template
+    runner: Runner
+    min_fee: int = 0
+    allowed_owners: tuple[str, ...] = ()
+    golden: tuple[dict, int, str] | None = None  # (input, seed, cid_hex)
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._models: dict[str, RegisteredModel] = {}
+
+    def register(self, model: RegisteredModel) -> None:
+        self._models[model.id.lower()] = model
+
+    def get(self, model_id: str) -> RegisteredModel | None:
+        return self._models.get(model_id.lower())
+
+    def ids(self) -> list[str]:
+        return list(self._models)
+
+
+def _check_declared(model: RegisteredModel, files: dict) -> dict:
+    declared = {o.filename for o in model.template.outputs}
+    if set(files) != declared:
+        raise ValueError(
+            f"runner produced {sorted(files)} but template declares "
+            f"{sorted(declared)}")
+    return files
+
+
+def solve_files(model: RegisteredModel, hydrated: dict, seed: int) -> dict:
+    """Run inference, return {filename: bytes} per the template outputs."""
+    return _check_declared(model, model.runner(hydrated, seed))
+
+
+def solve_files_batch(model: RegisteredModel,
+                      items: list[tuple[dict, int]]) -> list[dict]:
+    """Batched inference over one shape bucket: a single XLA dispatch when
+    the runner supports it (`run_batch`), else a per-item loop. Output
+    bytes are identical either way — the pipeline pads buckets to a
+    canonical batch, so batch size never changes a sample's bits."""
+    run_batch = getattr(model.runner, "run_batch", None)
+    if run_batch is not None and len(items) > 1:
+        return [_check_declared(model, f) for f in run_batch(items)]
+    return [solve_files(model, h, s) for h, s in items]
+
+
+EVIL_CID = ("0x1220000000000000000000000000000000000000000000000000000000000"
+            "0000666")
+
+
+def solve_cid(model: RegisteredModel, hydrated: dict, seed: int,
+              *, evilmode: bool = False) -> tuple[str, dict]:
+    """The commitment-bound CID for a task: dir-wrapped root of the output
+    files (ipfs.ts:28-76 path). evilmode emits a deliberately wrong CID
+    for contestation drills (models.ts:40-42)."""
+    if evilmode:
+        return EVIL_CID, {}
+    files = solve_files(model, hydrated, seed)
+    return cid_hex(cid_of_solution_files(files)), files
+
+
+def solve_cid_batch(model: RegisteredModel, items: list[tuple[dict, int]],
+                    *, evilmode: bool = False) -> list[tuple[str, dict]]:
+    """Batched solve_cid over one shape bucket."""
+    if evilmode:
+        return [(EVIL_CID, {})] * len(items)
+    out = []
+    for files in solve_files_batch(model, items):
+        out.append((cid_hex(cid_of_solution_files(files)), files))
+    return out
+
+
+class SD15Runner:
+    """anythingv3-class runner: SD-1.5 pipeline → deterministic PNG.
+
+    Template variables (templates/anythingv3.json): prompt,
+    negative_prompt, width, height, num_inference_steps, guidance_scale,
+    scheduler (enum), seed (injected from taskid).
+    """
+
+    def __init__(self, pipeline, params, out_name: str = "out-1.png"):
+        self.pipeline = pipeline
+        self.params = params
+        self.out_name = out_name
+
+    def __call__(self, hydrated: dict, seed: int) -> dict:
+        return self.run_batch([(hydrated, seed)])[0]
+
+    def run_batch(self, items: list[tuple[dict, int]]) -> list[dict]:
+        """One dp-batched XLA dispatch for a whole shape bucket: every item
+        shares (width, height, steps, scheduler) — the node's bucket key —
+        while prompts, guidance, and seeds vary per sample."""
+        first = items[0][0]
+        images = self.pipeline.generate(
+            self.params,
+            prompts=[h["prompt"] for h, _ in items],
+            negative_prompts=[h.get("negative_prompt", "") for h, _ in items],
+            seeds=[s for _, s in items],
+            width=int(first.get("width", 512)),
+            height=int(first.get("height", 512)),
+            num_inference_steps=int(first.get("num_inference_steps", 20)),
+            guidance_scale=[float(h.get("guidance_scale", 7.5))
+                            for h, _ in items],
+            scheduler=first.get("scheduler", "DDIM"),
+        )
+        return [{self.out_name: encode_png(np.asarray(images[i]))}
+                for i in range(len(items))]

@@ -45,10 +45,31 @@ def initialize_distributed(
         process_id = int(env) if env else None
     if coordinator_address is None and num_processes in (None, 1):
         if auto_detect is None:
-            auto_detect = jax.default_backend() == "tpu"
+            # Must NOT touch the backend here: jax.distributed.initialize()
+            # raises if any JAX call has already initialized XLA. Sniff the
+            # environment instead (TPU VM metadata / explicit platform).
+            auto_detect = (
+                os.environ.get("JAX_PLATFORMS", "").startswith("tpu")
+                or os.environ.get("TPU_WORKER_HOSTNAMES") is not None
+                or os.environ.get("TPU_SKIP_MDS_QUERY") is not None
+                or os.path.exists("/dev/accel0")
+                or os.path.exists("/dev/vfio")
+            )
         if not auto_detect:
             return False  # single host, nothing to do
-        jax.distributed.initialize()  # TPU pod metadata auto-detection
+        try:
+            jax.distributed.initialize()  # TPU pod metadata auto-detection
+        except Exception as e:  # noqa: BLE001
+            # Single-host TPU has no pod metadata and lands here by design.
+            # On a real pod slice this is NOT benign — the other workers
+            # formed a pod without us — so log loudly before degrading.
+            import logging
+
+            logging.getLogger("arbius.parallel").warning(
+                "jax.distributed.initialize() auto-detect failed (%r); "
+                "continuing single-process. If this host is part of a "
+                "multi-host slice, pass coordinator_address explicitly.", e)
+            return False
         _initialized = True
         return jax.process_count() > 1
     jax.distributed.initialize(

@@ -74,6 +74,7 @@ def test_tp_rules_hit_real_sd15_param_tree():
     import re
 
     from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline, ByteTokenizer
+    from arbius_tpu.parallel.sharding import _path_str
 
     pipe = SD15Pipeline(SD15Config.tiny(),
                         tokenizer=ByteTokenizer(max_length=16,
@@ -81,9 +82,7 @@ def test_tp_rules_hit_real_sd15_param_tree():
     params = pipe.init_params(seed=0)
     paths = []
     jax.tree_util.tree_map_with_path(
-        lambda p, _: paths.append("/".join(
-            str(getattr(k, "key", getattr(k, "idx", k))) for k in p)),
-        params)
+        lambda p, _: paths.append(_path_str(p)), params)
     for pat, _ in DEFAULT_TP_RULES:
         hits = [p for p in paths if re.match(pat, p)]
         assert hits, f"TP rule {pat!r} matches nothing in the SD15 tree"
