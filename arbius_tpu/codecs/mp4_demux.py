@@ -1,0 +1,116 @@
+"""MJPEG-MP4 demuxer — the input side of the video-matting path.
+
+RVM's template input is a video *file* (`templates/robust_video_matting
+.json`, type file); the node must turn those bytes into frames before
+inference. This parses the ISO BMFF structure (stsz/stco sample tables)
+and decodes the JPEG samples via PIL — handles the framework's own muxer
+profile (codecs/mp4.py) and any MJPEG-in-MP4 file.
+
+Note on determinism: input decoding sits UPSTREAM of inference, so the
+decoder build is part of the solve's determinism class exactly like the
+model weights are — the environment pins PIL. Output encoding (the bytes
+that get CID'd) never goes through a third-party codec.
+"""
+from __future__ import annotations
+
+import io
+import struct
+
+import numpy as np
+
+
+def _boxes(data: bytes, start: int, end: int):
+    off = start
+    while off + 8 <= end:
+        size = struct.unpack(">I", data[off:off + 4])[0]
+        tag = data[off + 4:off + 8]
+        if size == 1:  # 64-bit largesize
+            size = struct.unpack(">Q", data[off + 8:off + 16])[0]
+            yield tag, off + 16, off + size
+        else:
+            if size == 0:
+                size = end - off
+            yield tag, off + 8, off + size
+        off += size
+
+
+def _find(data: bytes, path: list[bytes], start=0, end=None):
+    if end is None:
+        end = len(data)
+    if not path:
+        return start, end
+    for tag, s, e in _boxes(data, start, end):
+        if tag == path[0]:
+            return _find(data, path[1:], s, e)
+    raise ValueError(f"box {path[0]!r} not found")
+
+
+def demux_mjpeg_mp4(data: bytes) -> list[bytes]:
+    """Extract per-sample JPEG bytes from an MJPEG MP4."""
+    stbl = _find(data, [b"moov", b"trak", b"mdia", b"minf", b"stbl"])
+    sizes = chunk_offsets = stsc = None
+    for tag, s, e in _boxes(data, *stbl):
+        if tag == b"stsz":
+            sample_size, count = struct.unpack(">II", data[s + 4:s + 12])
+            if sample_size:
+                sizes = [sample_size] * count
+            else:
+                sizes = list(struct.unpack(f">{count}I",
+                                           data[s + 12:s + 12 + 4 * count]))
+        elif tag == b"stco":
+            count = struct.unpack(">I", data[s + 4:s + 8])[0]
+            chunk_offsets = list(struct.unpack(
+                f">{count}I", data[s + 8:s + 8 + 4 * count]))
+        elif tag == b"co64":
+            count = struct.unpack(">I", data[s + 4:s + 8])[0]
+            chunk_offsets = list(struct.unpack(
+                f">{count}Q", data[s + 8:s + 8 + 8 * count]))
+        elif tag == b"stsc":
+            count = struct.unpack(">I", data[s + 4:s + 8])[0]
+            stsc = [struct.unpack(">III", data[s + 8 + 12 * i:
+                                               s + 20 + 12 * i])
+                    for i in range(count)]  # (first_chunk, per_chunk, desc)
+    if sizes is None or chunk_offsets is None:
+        raise ValueError("no sample tables (stsz/stco) found")
+
+    # expand stsc runs into samples-per-chunk, then walk chunks laying
+    # samples contiguously from each chunk offset
+    n_chunks = len(chunk_offsets)
+    per_chunk = [1] * n_chunks
+    if stsc:
+        for i, (first, count, _) in enumerate(stsc):
+            last = stsc[i + 1][0] - 1 if i + 1 < len(stsc) else n_chunks
+            for c in range(first - 1, last):
+                per_chunk[c] = count
+    offsets = []
+    si = 0
+    for ci, base in enumerate(chunk_offsets):
+        off = base
+        for _ in range(per_chunk[ci]):
+            if si >= len(sizes):
+                break
+            offsets.append(off)
+            off += sizes[si]
+            si += 1
+    if si != len(sizes):
+        raise ValueError(
+            f"sample tables inconsistent: stsc/stco cover {si} samples, "
+            f"stsz declares {len(sizes)}")
+    samples = []
+    for off, sz in zip(offsets, sizes):
+        blob = data[off:off + sz]
+        if blob[:2] != b"\xff\xd8":
+            raise ValueError(f"sample at {off} is not a JPEG (MJPEG only)")
+        samples.append(blob)
+    return samples
+
+
+def decode_mjpeg_mp4(data: bytes) -> np.ndarray:
+    """MJPEG MP4 bytes → uint8 [T, H, W, 3] RGB frames."""
+    from PIL import Image
+
+    frames = [np.asarray(Image.open(io.BytesIO(s)).convert("RGB"))
+              for s in demux_mjpeg_mp4(data)]
+    if not frames:
+        raise ValueError("no frames")
+    return np.stack(frames)

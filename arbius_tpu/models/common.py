@@ -109,12 +109,18 @@ class Attention(nn.Module):
             return t.reshape(b, s, self.num_heads, self.head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = split(q), split(k), split(v)
-        scale = 1.0 / np.sqrt(self.head_dim)
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-        if mask is not None:
+        if mask is None:
+            # dispatches to the pallas flash kernel on TPU for long S
+            # (ops/flash.py), XLA einsum otherwise — same math either way
+            from arbius_tpu.ops.flash import attention as fused_attention
+
+            out = fused_attention(q, k, v)
+        else:
+            scale = 1.0 / np.sqrt(self.head_dim)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
             logits = logits + mask
-        probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            probs = jax.nn.softmax(logits, axis=-1).astype(self.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         return nn.Dense(inner, dtype=self.dtype, name="to_out")(out)

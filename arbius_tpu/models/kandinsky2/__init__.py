@@ -1,0 +1,22 @@
+"""Kandinsky-2 model family: diffusion prior + decoder UNet + MOVQ.
+
+The reference's flagship/boot-self-test model class
+(`templates/kandinsky2.json`, `miner/src/index.ts:844-877`).
+"""
+from arbius_tpu.models.kandinsky2.decoder import DecoderConfig, DecoderUNet
+from arbius_tpu.models.kandinsky2.movq import MOVQConfig, MOVQDecoder
+from arbius_tpu.models.kandinsky2.pipeline import (
+    Kandinsky2Config,
+    Kandinsky2Pipeline,
+)
+from arbius_tpu.models.kandinsky2.prior import (
+    PriorConfig,
+    PriorTransformer,
+    prior_sample,
+)
+
+__all__ = [
+    "DecoderConfig", "DecoderUNet", "Kandinsky2Config", "Kandinsky2Pipeline",
+    "MOVQConfig", "MOVQDecoder", "PriorConfig", "PriorTransformer",
+    "prior_sample",
+]

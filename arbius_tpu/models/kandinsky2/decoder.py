@@ -1,0 +1,48 @@
+"""Kandinsky-2 decoder UNet: CLIP-image-embedding-conditioned denoiser.
+
+Second diffusion stage of the kandinsky2 template: where SD-1.5
+cross-attends over 77 text tokens, Kandinsky's decoder conditions on the
+single CLIP image embedding the prior produced — projected both into a
+short context token sequence (cross-attention) and into the timestep
+embedding (additive). Reuses the shared UNet2DCondition topology; only
+the conditioning head differs, so the TPU execution profile (bucketed
+static shapes, bf16 MXU convs/attention) is identical to SD-1.5's.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from arbius_tpu.models.sd15.unet import UNet2DCondition, UNetConfig
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    unet: UNetConfig = UNetConfig(block_channels=(384, 768, 1152, 1536),
+                                  num_heads=12, context_dim=768)
+    clip_dim: int = 768
+    context_tokens: int = 10      # image embed → this many pseudo-tokens
+
+    @classmethod
+    def tiny(cls) -> "DecoderConfig":
+        return cls(unet=UNetConfig.tiny(), clip_dim=16, context_tokens=2)
+
+
+class DecoderUNet(nn.Module):
+    """__call__(latents[B,h,w,4], t[B], image_embed[B,clip_dim]) -> eps."""
+    config: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x, t, image_embed):
+        cfg = self.config
+        dt = cfg.unet.jdtype
+        emb = image_embed.astype(dt)
+        ctx = nn.Dense(cfg.context_tokens * cfg.unet.context_dim, dtype=dt,
+                       name="embed_to_context")(emb)
+        ctx = ctx.reshape(emb.shape[0], cfg.context_tokens,
+                          cfg.unet.context_dim)
+        ctx = nn.LayerNorm(dtype=jnp.float32, name="context_norm")(
+            ctx.astype(jnp.float32)).astype(dt)
+        return UNet2DCondition(cfg.unet, name="unet")(x, t, ctx)

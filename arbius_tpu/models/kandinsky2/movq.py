@@ -1,0 +1,103 @@
+"""MOVQ decoder — Kandinsky-2's latent→pixel stage.
+
+Capability target: the MOVQ/VQ decoder of the kandinsky2 template
+(`templates/kandinsky2.json` model class, SURVEY.md §2.3). MOVQ is a
+VQGAN-style decoder whose distinguishing feature is *spatially modulated*
+group norm: normalization parameters are conv-predicted from the quantized
+latent, re-injecting spatial detail at every scale.
+
+TPU notes: NHWC convs in bf16, norms in f32 (same policy as models/common);
+attention at the lowest resolution only, so the op mix is conv-dominated —
+pure MXU work with no dynamic shapes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from arbius_tpu.models.common import Attention, GroupNorm32, Upsample
+
+
+@dataclass(frozen=True)
+class MOVQConfig:
+    latent_channels: int = 4
+    block_channels: tuple[int, ...] = (128, 256, 256, 512)  # low→high res order
+    layers_per_block: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def tiny(cls) -> "MOVQConfig":
+        return cls(block_channels=(8, 8, 8, 8), layers_per_block=1)
+
+
+class SpatialNorm(nn.Module):
+    """GroupNorm whose scale/shift are conv-predicted from the latent."""
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, h, z):
+        b, hh, ww, c = h.shape
+        z_up = jax.image.resize(z, (b, hh, ww, z.shape[-1]), method="nearest")
+        normed = GroupNorm32(name="norm")(h)
+        scale = nn.Conv(c, (1, 1), dtype=self.dtype, name="conv_y")(z_up)
+        shift = nn.Conv(c, (1, 1), dtype=self.dtype, name="conv_b")(z_up)
+        return normed * (1 + scale.astype(normed.dtype)) + shift.astype(normed.dtype)
+
+
+class MOVQResBlock(nn.Module):
+    out_channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, z):
+        h = SpatialNorm(self.dtype, name="norm1")(x, z)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype)(h)
+        h = SpatialNorm(self.dtype, name="norm2")(h, z)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype)(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype,
+                        name="skip")(x)
+        return x + h
+
+
+class MOVQDecoder(nn.Module):
+    """__call__(z[B,h,w,4]) -> pixels[B,8h,8w,3] in [-1, 1]."""
+    config: MOVQConfig
+
+    @nn.compact
+    def __call__(self, z):
+        cfg = self.config
+        dt = cfg.jdtype
+        z = z.astype(dt)
+        chans = cfg.block_channels
+        h = nn.Conv(chans[-1], (3, 3), padding=1, dtype=dt, name="conv_in")(z)
+
+        # mid: res + attention + res at the lowest resolution
+        h = MOVQResBlock(chans[-1], dt, name="mid_res_0")(h, z)
+        b, hh, ww, c = h.shape
+        attn_in = SpatialNorm(dt, name="mid_attn_norm")(h, z).reshape(b, hh * ww, c)
+        h = h + Attention(num_heads=1, head_dim=c, dtype=dt, name="mid_attn")(
+            attn_in).reshape(b, hh, ww, c)
+        h = MOVQResBlock(chans[-1], dt, name="mid_res_1")(h, z)
+
+        # upsampling tower: 3 doublings (×8 total like the VAE factor)
+        for level in reversed(range(len(chans))):
+            for j in range(cfg.layers_per_block):
+                h = MOVQResBlock(chans[level], dt,
+                                 name=f"up_{level}_res_{j}")(h, z)
+            if level > 0:
+                h = Upsample(chans[level], dt, name=f"up_{level}_us")(h)
+
+        h = SpatialNorm(dt, name="norm_out")(h, z)
+        h = nn.silu(h)
+        return nn.Conv(3, (3, 3), padding=1, dtype=jnp.float32,
+                       name="conv_out")(h.astype(jnp.float32))

@@ -1,0 +1,194 @@
+"""Kandinsky-2 txt2img pipeline: text → prior → decoder → MOVQ, in-process.
+
+The reference's flagship mining path (kandinsky2 is its only enabled model
+AND the boot self-test, `miner/src/index.ts:844-877`, :984-1001) as one
+jitted XLA program per shape bucket. Same determinism contract as SD-1.5:
+the per-task seed keys every stochastic draw via fold_in, buckets run at a
+canonical batch, so output bytes depend only on (model build, input, seed).
+
+Template parity (`templates/kandinsky2.json`): prompt, negative_prompt
+(unused by the prior's CFG-zero branch but accepted), w/h ∈ {768, 1024},
+num_inference_steps, guidance_scale, seed; output out-1.png.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arbius_tpu.models.kandinsky2.decoder import DecoderConfig, DecoderUNet
+from arbius_tpu.models.kandinsky2.movq import MOVQConfig, MOVQDecoder
+from arbius_tpu.models.kandinsky2.prior import (
+    PriorConfig,
+    PriorTransformer,
+    prior_sample,
+)
+from arbius_tpu.models.sd15.text_encoder import TextEncoder, TextEncoderConfig
+from arbius_tpu.models.sd15.tokenizer import ByteTokenizer
+from arbius_tpu.models.sd15.vae import decode_to_images
+from arbius_tpu.schedulers import get_sampler
+
+
+@dataclass(frozen=True)
+class Kandinsky2Config:
+    prior: PriorConfig = PriorConfig()
+    decoder: DecoderConfig = DecoderConfig()
+    movq: MOVQConfig = MOVQConfig()
+    text: TextEncoderConfig = TextEncoderConfig()
+    prior_steps: int = 25
+
+    @classmethod
+    def tiny(cls) -> "Kandinsky2Config":
+        return cls(prior=PriorConfig.tiny(), decoder=DecoderConfig.tiny(),
+                   movq=MOVQConfig.tiny(), text=TextEncoderConfig.tiny(),
+                   prior_steps=2)
+
+
+class Kandinsky2Pipeline:
+    """Stateless module bundle + jitted per-bucket executables."""
+
+    MOVQ_FACTOR = 8
+
+    def __init__(self, config: Kandinsky2Config | None = None, tokenizer=None,
+                 mesh=None):
+        self.config = config or Kandinsky2Config()
+        self.mesh = mesh
+        if self.config.text.width != self.config.prior.clip_dim:
+            raise ValueError(
+                f"text width ({self.config.text.width}) must equal prior "
+                f"clip_dim ({self.config.prior.clip_dim}) — the prior "
+                "consumes raw text-encoder states")
+        if self.config.text.max_length < self.config.prior.text_len:
+            raise ValueError(
+                f"text max_length ({self.config.text.max_length}) must be "
+                f">= prior text_len ({self.config.prior.text_len})")
+        self.tokenizer = tokenizer or ByteTokenizer(
+            max_length=self.config.text.max_length)
+        self.text_encoder = TextEncoder(self.config.text)
+        self.prior = PriorTransformer(self.config.prior)
+        self.decoder = DecoderUNet(self.config.decoder)
+        self.movq = MOVQDecoder(self.config.movq)
+        self._buckets: dict[tuple, object] = {}
+
+    # -- params ----------------------------------------------------------
+    def init_params(self, seed: int = 0, height: int = 64, width: int = 64) -> dict:
+        k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(seed), 4)
+        cfg = self.config
+        lh, lw = height // self.MOVQ_FACTOR, width // self.MOVQ_FACTOR
+        ids = jnp.zeros((1, cfg.text.max_length), jnp.int32)
+        tok = jnp.zeros((1, cfg.prior.text_len, cfg.prior.clip_dim))
+        pooled = jnp.zeros((1, cfg.prior.clip_dim))
+        embed = jnp.zeros((1, cfg.prior.clip_dim))
+        lat = jnp.zeros((1, lh, lw, cfg.decoder.unet.in_channels))
+        return {
+            "text": self.text_encoder.init(k1, ids)["params"],
+            "prior": self.prior.init(k2, embed, jnp.zeros((1,)), tok,
+                                     pooled)["params"],
+            "decoder": self.decoder.init(k3, lat, jnp.zeros((1,)),
+                                         embed)["params"],
+            "movq": self.movq.init(k4, lat)["params"],
+        }
+
+    def place_params(self, params: dict, tp_rules=None) -> dict:
+        if self.mesh is None:
+            return params
+        from arbius_tpu.parallel import DEFAULT_TP_RULES, shard_params
+
+        return shard_params(params, self.mesh,
+                            tp_rules if tp_rules is not None else DEFAULT_TP_RULES)
+
+    def _place_batch(self, *arrays):
+        if self.mesh is None:
+            return arrays
+        from arbius_tpu.parallel import batch_sharding
+
+        return tuple(jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+                     for a in arrays)
+
+    # -- compiled bucket -------------------------------------------------
+    def compiled_bucket(self, batch: int, height: int, width: int,
+                        steps: int, scheduler: str):
+        key = (batch, height, width, steps, scheduler)
+        cached = self._buckets.get(key)
+        if cached is not None:
+            return cached
+        cfg = self.config
+        sampler = get_sampler(scheduler, steps)
+        lh, lw = height // self.MOVQ_FACTOR, width // self.MOVQ_FACTOR
+        lat_shape = (batch, lh, lw, cfg.decoder.unet.in_channels)
+        text_len = cfg.prior.text_len
+
+        def run(params, ids, guidance, seeds_lo, seeds_hi):
+            states = self.text_encoder.apply({"params": params["text"]}, ids)
+            # prior consumes a fixed text_len window + pooled (last token)
+            tok = states[:, :text_len]
+            pooled = states[:, -1]
+            keys = jax.vmap(
+                lambda lo, hi: jax.random.fold_in(jax.random.PRNGKey(lo), hi)
+            )(seeds_lo, seeds_hi)
+            g = guidance.astype(jnp.float32)
+
+            embed = prior_sample(self.prior, params["prior"], tok, pooled,
+                                 keys, g, steps=cfg.prior_steps)
+
+            x = jax.vmap(lambda k: jax.random.normal(
+                k, lat_shape[1:], jnp.float32))(keys)
+            x = x * sampler.init_noise_sigma
+            zero_embed = jnp.zeros_like(embed)
+            g4 = g[:, None, None, None]
+
+            def body(carry, i):
+                x, state = carry
+                xin = jnp.concatenate([x, x], axis=0) * sampler.input_scale[i]
+                t = jnp.full((2 * batch,), sampler.timesteps[i])
+                emb2 = jnp.concatenate([zero_embed, embed], axis=0)
+                eps = self.decoder.apply({"params": params["decoder"]},
+                                         xin, t, emb2)
+                eps_u, eps_c = jnp.split(eps.astype(jnp.float32), 2, axis=0)
+                eps = eps_u + g4 * (eps_c - eps_u)
+                noise = jax.vmap(lambda k: jax.random.normal(
+                    jax.random.fold_in(k, i), lat_shape[1:], jnp.float32))(keys)
+                x, state = sampler.step(i, x, eps, state, noise)
+                return (x, state), None
+
+            (x, _), _ = jax.lax.scan(body, (x, sampler.init_carry(x)),
+                                     jnp.arange(sampler.num_model_calls))
+            pixels = self.movq.apply({"params": params["movq"]}, x)
+            return decode_to_images(pixels)
+
+        fn = jax.jit(run)
+        self._buckets[key] = fn
+        return fn
+
+    # -- public API ------------------------------------------------------
+    def generate(self, params: dict, prompts: list[str],
+                 negative_prompts: list[str] | None, seeds: list[int], *,
+                 width: int = 768, height: int = 768,
+                 num_inference_steps: int = 50,
+                 guidance_scale: float | list[float] = 4.0,
+                 scheduler: str = "DDIM") -> np.ndarray:
+        batch = len(prompts)
+        if len(seeds) != batch:
+            raise ValueError("prompts/seeds must align")
+        levels = len(self.config.decoder.unet.block_channels)
+        granule = self.MOVQ_FACTOR * (2 ** (levels - 1))
+        if height % granule or width % granule:
+            raise ValueError(f"height/width must be multiples of {granule}")
+        g = list(guidance_scale) if isinstance(guidance_scale, (list, tuple)) \
+            else [guidance_scale] * batch
+        if self.mesh is not None and batch % self.mesh.shape["dp"]:
+            raise ValueError(
+                f"batch {batch} not divisible by dp={self.mesh.shape['dp']}")
+        fn = self.compiled_bucket(batch, height, width, num_inference_steps,
+                                  scheduler)
+        ids = self.tokenizer.encode_batch(prompts)
+        seeds_arr = np.asarray(seeds, dtype=np.uint64)
+        args = self._place_batch(
+            jnp.asarray(ids),
+            jnp.asarray(g, jnp.float32),
+            jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
+            jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
+        )
+        return np.asarray(fn(params, *args))

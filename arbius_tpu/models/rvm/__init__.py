@@ -1,0 +1,11 @@
+"""Robust Video Matting family: recurrent ConvGRU matting
+(`templates/robust_video_matting.json` model class)."""
+from arbius_tpu.models.rvm.model import ConvGRUCell, RVMConfig, RVMStep
+from arbius_tpu.models.rvm.pipeline import (
+    OUTPUT_TYPES,
+    RVMPipeline,
+    RVMPipelineConfig,
+)
+
+__all__ = ["ConvGRUCell", "OUTPUT_TYPES", "RVMConfig", "RVMPipeline",
+           "RVMPipelineConfig", "RVMStep"]

@@ -1,0 +1,133 @@
+"""Robust Video Matting — recurrent ConvGRU matting network.
+
+Capability target: `templates/robust_video_matting.json` (SURVEY.md §2.3):
+video file in, matted video out (output_type ∈ green-screen | alpha-mask |
+foreground-mask). RVM's defining property is *recurrence*: per-scale
+ConvGRU states carry temporal context frame to frame, so the model streams
+— which on TPU means `lax.scan` over the frame axis with the GRU states as
+carry (no frame-axis SP here by design; the reference model is inherently
+sequential over frames, SURVEY.md §5 long-context notes).
+
+Topology (faithful to the RVM design, sized for the template's task):
+strided-conv encoder pyramid (1/2..1/16) → bottleneck → decoder that
+upsamples with skip connections and a ConvGRU at each scale → output head
+producing alpha [0,1] + foreground residual.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from arbius_tpu.models.common import GroupNorm32
+
+
+@dataclass(frozen=True)
+class RVMConfig:
+    enc_channels: tuple[int, ...] = (16, 32, 64, 128)   # scales 1/2..1/16
+    dec_channels: tuple[int, ...] = (80, 40, 32, 16)    # coarse→fine
+    dtype: str = "bfloat16"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def tiny(cls) -> "RVMConfig":
+        return cls(enc_channels=(4, 8, 8, 8), dec_channels=(8, 8, 4, 4))
+
+
+class ConvGRUCell(nn.Module):
+    """Convolutional GRU over NHWC feature maps (the RVM recurrent unit)."""
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, h, x):
+        hx = jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)],
+                             axis=-1)
+        zr = nn.Conv(2 * self.channels, (3, 3), padding=1, dtype=self.dtype,
+                     name="zr")(hx)
+        z, r = jnp.split(nn.sigmoid(zr.astype(jnp.float32)), 2, axis=-1)
+        cand = nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype,
+                       name="cand")(
+            jnp.concatenate([(r * h.astype(jnp.float32)).astype(self.dtype),
+                             x.astype(self.dtype)], axis=-1))
+        cand = jnp.tanh(cand.astype(jnp.float32))
+        return (1 - z) * h.astype(jnp.float32) + z * cand
+
+
+class EncoderBlock(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.channels, (3, 3), strides=(2, 2), padding=1,
+                    dtype=self.dtype)(x)
+        x = GroupNorm32()(x)
+        x = nn.silu(x)
+        x = nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype)(x)
+        x = GroupNorm32()(x)
+        return nn.silu(x)
+
+
+class RVMStep(nn.Module):
+    """One frame through encoder+recurrent decoder.
+
+    __call__(frame[B,H,W,3], states) -> (alpha[B,H,W,1], fgr[B,H,W,3],
+    new_states); `states` is a tuple of per-scale GRU hidden maps.
+    """
+    config: RVMConfig
+
+    @nn.compact
+    def __call__(self, frame, states):
+        cfg = self.config
+        dt = cfg.jdtype
+        x = frame.astype(dt)
+        feats = []
+        h = x
+        for i, ch in enumerate(cfg.enc_channels):
+            h = EncoderBlock(ch, dt, name=f"enc_{i}")(h)
+            feats.append(h)
+
+        new_states = []
+        d = feats[-1]
+        for i, ch in enumerate(cfg.dec_channels):
+            scale_idx = len(cfg.enc_channels) - 1 - i
+            d = nn.Conv(ch, (3, 3), padding=1, dtype=dt,
+                        name=f"dec_conv_{i}")(d)
+            d = nn.silu(GroupNorm32(name=f"dec_norm_{i}")(d))
+            s = ConvGRUCell(ch, dt, name=f"gru_{i}")(states[i], d)
+            new_states.append(s)
+            d = s.astype(dt)
+            if scale_idx > 0:
+                b, hh, ww, c = d.shape
+                d = jax.image.resize(d, (b, hh * 2, ww * 2, c),
+                                     method="nearest")
+                skip = feats[scale_idx - 1]
+                d = jnp.concatenate([d, skip], axis=-1)
+        # final upsample to input resolution (encoder starts at 1/2)
+        b, hh, ww, c = d.shape
+        d = jax.image.resize(d, (b, hh * 2, ww * 2, c), method="nearest")
+        d = jnp.concatenate([d, x], axis=-1)
+        d = nn.Conv(cfg.dec_channels[-1], (3, 3), padding=1, dtype=dt,
+                    name="out_conv")(d)
+        d = nn.silu(GroupNorm32(name="out_norm")(d))
+        out = nn.Conv(4, (3, 3), padding=1, dtype=jnp.float32,
+                      name="head")(d.astype(jnp.float32))
+        alpha = nn.sigmoid(out[..., :1])
+        fgr = jnp.clip(frame.astype(jnp.float32) + out[..., 1:], 0.0, 1.0)
+        return alpha, fgr, tuple(new_states)
+
+    def init_states(self, batch: int, height: int, width: int):
+        """Zero GRU states for a (batch, H, W) stream."""
+        cfg = self.config
+        states = []
+        for i, ch in enumerate(cfg.dec_channels):
+            scale = 2 ** (len(cfg.enc_channels) - i)
+            states.append(jnp.zeros((batch, height // scale, width // scale,
+                                     ch), jnp.float32))
+        return tuple(states)

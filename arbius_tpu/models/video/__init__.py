@@ -1,0 +1,14 @@
+"""Text-to-video model family: UNet3D (zeroscope/damo template classes)
+with built-in frame-axis sequence parallelism."""
+from arbius_tpu.models.video.pipeline import Text2VideoConfig, Text2VideoPipeline
+from arbius_tpu.models.video.unet3d import (
+    TemporalAttention,
+    TemporalConv,
+    UNet3DCondition,
+    UNet3DConfig,
+)
+
+__all__ = [
+    "TemporalAttention", "TemporalConv", "Text2VideoConfig",
+    "Text2VideoPipeline", "UNet3DCondition", "UNet3DConfig",
+]

@@ -1,0 +1,218 @@
+"""Text-to-video pipeline (zeroscope / damo template classes).
+
+End-to-end jitted program per shape bucket: text encode → CFG UNet3D
+denoise scan → per-frame VAE decode → uint8 frames. The node's video
+runner encodes the frames to deterministic MJPEG/MP4 (codecs.encode_mp4)
+and CIDs the bytes — replacing the reference's cog container + ffmpeg
+black box (`templates/zeroscopev2xl.json` out-1.mp4).
+
+Parallel layout (mesh axes): dp shards samples, sp shards FRAMES — the
+whole denoise scan runs under one shard_map, temporal ops communicating
+via halo exchange + ring attention (see unet3d.py). Noise is derived per
+(sample-key, step, GLOBAL frame index), so the sp layout does not change
+which noise a frame sees — resharding changes only reduction order, not
+the random stream.
+
+Determinism contract: same as SD-1.5/Kandinsky — (model build, input,
+seed, bucket, mesh layout) fixes output bytes; buckets are padded to a
+canonical batch by the node.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arbius_tpu.models.sd15.text_encoder import TextEncoder, TextEncoderConfig
+from arbius_tpu.models.sd15.tokenizer import ByteTokenizer
+from arbius_tpu.models.sd15.vae import (
+    SD_LATENT_SCALE,
+    VAEConfig,
+    VAEDecoder,
+    decode_to_images,
+)
+from arbius_tpu.models.video.unet3d import UNet3DCondition, UNet3DConfig
+from arbius_tpu.schedulers import get_sampler
+
+
+@dataclass(frozen=True)
+class Text2VideoConfig:
+    unet: UNet3DConfig = UNet3DConfig()
+    vae: VAEConfig = VAEConfig()
+    text: TextEncoderConfig = TextEncoderConfig(width=1024)
+
+    @classmethod
+    def tiny(cls, sp_axis: str | None = None) -> "Text2VideoConfig":
+        return cls(unet=UNet3DConfig.tiny(sp_axis=sp_axis),
+                   vae=VAEConfig.tiny(),
+                   text=TextEncoderConfig.tiny())
+
+
+class Text2VideoPipeline:
+    VAE_FACTOR = 8
+
+    def __init__(self, config: Text2VideoConfig | None = None, tokenizer=None,
+                 mesh=None):
+        self.config = config or Text2VideoConfig()
+        self.mesh = mesh
+        if self.config.text.width != self.config.unet.context_dim:
+            raise ValueError(
+                f"text width ({self.config.text.width}) must equal unet "
+                f"context_dim ({self.config.unet.context_dim})")
+        sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if sp > 1 and self.config.unet.sp_axis != "sp":
+            raise ValueError(
+                "mesh has sp>1 but unet.sp_axis is not 'sp' — the model "
+                "must be built sharding-aware (UNet3DConfig(sp_axis='sp'))")
+        if sp == 1 and self.config.unet.sp_axis is not None and mesh is None:
+            raise ValueError("unet.sp_axis set but no mesh given")
+        self.tokenizer = tokenizer or ByteTokenizer(
+            max_length=self.config.text.max_length)
+        self.text_encoder = TextEncoder(self.config.text)
+        self.unet = UNet3DCondition(self.config.unet)
+        self.vae = VAEDecoder(self.config.vae)
+        self._buckets: dict[tuple, object] = {}
+
+    # -- params ----------------------------------------------------------
+    def init_params(self, seed: int = 0, frames: int = 2, height: int = 64,
+                    width: int = 64) -> dict:
+        """Init with sp_axis disabled (collectives need a mesh); the param
+        tree is identical either way, so these params drive both paths."""
+        cfg = self.config
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
+        lat = jnp.zeros((1, frames, lh, lw, cfg.unet.in_channels))
+        ids = jnp.zeros((1, cfg.text.max_length), jnp.int32)
+        ctx = jnp.zeros((1, cfg.text.max_length, cfg.unet.context_dim))
+        unet_local = UNet3DCondition(
+            dataclasses.replace(cfg.unet, sp_axis=None))
+        return {
+            "unet": unet_local.init(k1, lat, jnp.zeros((1,)), ctx)["params"],
+            "vae": self.vae.init(k2, lat[:, 0])["params"],
+            "text": self.text_encoder.init(k3, ids)["params"],
+        }
+
+    def place_params(self, params: dict, tp_rules=()) -> dict:
+        """Video path shards dp×sp via shard_map with replicated params
+        (in_spec P()); TP param sharding is not wired into this pipeline,
+        so the default is full replication — pass rules only if you also
+        change the shard_map in_specs."""
+        if self.mesh is None:
+            return params
+        from arbius_tpu.parallel import shard_params
+
+        return shard_params(params, self.mesh, list(tp_rules))
+
+    # -- compiled bucket -------------------------------------------------
+    def compiled_bucket(self, batch: int, frames: int, height: int,
+                        width: int, steps: int, scheduler: str):
+        key = (batch, frames, height, width, steps, scheduler)
+        cached = self._buckets.get(key)
+        if cached is not None:
+            return cached
+        cfg = self.config
+        sampler = get_sampler(scheduler, steps)
+        lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
+        sp = self.mesh.shape.get("sp", 1) if self.mesh is not None else 1
+        dp = self.mesh.shape.get("dp", 1) if self.mesh is not None else 1
+        if frames % sp:
+            raise ValueError(f"frames {frames} not divisible by sp={sp}")
+        if batch % dp:
+            raise ValueError(f"batch {batch} not divisible by dp={dp}")
+        t_local = frames // sp
+
+        def run(params, ids_c, ids_u, guidance, seeds_lo, seeds_hi):
+            b_local = ids_c.shape[0]
+            if cfg.unet.sp_axis is not None:
+                sp_rank = jax.lax.axis_index(cfg.unet.sp_axis)
+            else:
+                sp_rank = 0
+            frame0 = sp_rank * t_local
+            ctx_c = self.text_encoder.apply({"params": params["text"]}, ids_c)
+            ctx_u = self.text_encoder.apply({"params": params["text"]}, ids_u)
+            context = jnp.concatenate([ctx_u, ctx_c], axis=0)
+
+            keys = jax.vmap(
+                lambda lo, hi: jax.random.fold_in(jax.random.PRNGKey(lo), hi)
+            )(seeds_lo, seeds_hi)
+
+            def noise_for(step_tag):
+                # noise keyed by (sample, step, GLOBAL frame): sp-invariant
+                def per_sample(k):
+                    kk = jax.random.fold_in(k, step_tag)
+                    return jax.vmap(lambda f: jax.random.normal(
+                        jax.random.fold_in(kk, f),
+                        (lh, lw, cfg.unet.in_channels), jnp.float32))(
+                        frame0 + jnp.arange(t_local))
+                return jax.vmap(per_sample)(keys)
+
+            # init-noise tag is outside the step range [0, num_model_calls)
+            x = noise_for(jnp.int32(1 << 30)) * sampler.init_noise_sigma
+            g = guidance.astype(jnp.float32)[:, None, None, None, None]
+
+            def body(carry, i):
+                x, state = carry
+                xin = jnp.concatenate([x, x], axis=0) * sampler.input_scale[i]
+                t = jnp.full((2 * b_local,), sampler.timesteps[i])
+                eps = self.unet.apply({"params": params["unet"]}, xin, t,
+                                      context)
+                eps_u, eps_c = jnp.split(eps.astype(jnp.float32), 2, axis=0)
+                eps = eps_u + g * (eps_c - eps_u)
+                x, state = sampler.step(i, x, eps, state, noise_for(i))
+                return (x, state), None
+
+            (x, _), _ = jax.lax.scan(body, (x, sampler.init_carry(x)),
+                                     jnp.arange(sampler.num_model_calls))
+            flat = x.reshape(b_local * t_local, lh, lw,
+                             cfg.unet.in_channels)
+            pixels = self.vae.apply({"params": params["vae"]},
+                                    flat / SD_LATENT_SCALE)
+            images = decode_to_images(pixels)
+            return images.reshape(b_local, t_local, height, width, 3)
+
+        if self.mesh is not None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            fn = jax.jit(shard_map(
+                run, mesh=self.mesh,
+                in_specs=(P(), P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
+                out_specs=P("dp", "sp"),
+                check_rep=False))
+        else:
+            fn = jax.jit(run)
+        self._buckets[key] = fn
+        return fn
+
+    # -- public API ------------------------------------------------------
+    def generate(self, params: dict, prompts: list[str],
+                 negative_prompts: list[str] | None, seeds: list[int], *,
+                 num_frames: int = 16, width: int = 256, height: int = 256,
+                 fps: int = 8, num_inference_steps: int = 20,
+                 guidance_scale: float | list[float] = 9.0,
+                 scheduler: str = "DDIM") -> np.ndarray:
+        del fps  # container metadata, applied by the mp4 muxer
+        batch = len(prompts)
+        negs = negative_prompts or [""] * batch
+        if len(negs) != batch or len(seeds) != batch:
+            raise ValueError("prompts/negative_prompts/seeds must align")
+        levels = len(self.config.unet.block_channels)
+        granule = self.VAE_FACTOR * (2 ** (levels - 1))
+        if height % granule or width % granule:
+            raise ValueError(f"height/width must be multiples of {granule}")
+        g = list(guidance_scale) if isinstance(guidance_scale, (list, tuple)) \
+            else [guidance_scale] * batch
+        fn = self.compiled_bucket(batch, num_frames, height, width,
+                                  num_inference_steps, scheduler)
+        ids_c = self.tokenizer.encode_batch(prompts)
+        ids_u = self.tokenizer.encode_batch(negs)
+        seeds_arr = np.asarray(seeds, dtype=np.uint64)
+        out = fn(params,
+                 jnp.asarray(ids_c), jnp.asarray(ids_u),
+                 jnp.asarray(g, jnp.float32),
+                 jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
+                 jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32))
+        return np.asarray(out)

@@ -1,0 +1,217 @@
+"""UNet3D — text-to-video denoiser (zeroscope / ModelScope model class).
+
+Capability target: `templates/zeroscopev2xl.json` (≤96 frames, 1024×576)
+and `templates/damo.json` (ModelScope 1.7B, 16 frames) — SURVEY.md §2.3.
+
+Architecture: the standard factorized inflation of the 2D UNet — every
+level interleaves (a) spatial resnet + spatial/text transformer applied
+per-frame, with (b) temporal convolution and (c) temporal attention
+applied per-pixel across frames. Temporal residual branches are
+zero-initialized, so at init the model is exactly the 2D UNet replicated
+over frames (the standard inflation trick, and a free correctness check).
+
+Sequence parallelism is built in, not bolted on (SURVEY.md §2.6 plan):
+with `sp_axis` set, the module runs under shard_map with the frame axis
+sharded — temporal convs fetch a 1-frame halo from ring neighbours
+(`halo_exchange`), temporal attention runs as ring attention
+(`ops.ring_attention`), everything else is frame-local. Comms per step:
+O(halo) + (sp-1) K/V hops, all ICI.
+
+Shapes: __call__(x[B, T, H, W, C], t[B], context[B, L, D]) — T is the
+per-shard frame count under shard_map, the full count otherwise.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from arbius_tpu.models.common import (
+    Downsample,
+    GroupNorm32,
+    ResnetBlock,
+    SpatialTransformer,
+    TimestepEmbedding,
+    Upsample,
+    sinusoidal_embedding,
+)
+from arbius_tpu.ops.ring import ring_attention, sp_attention_reference
+from arbius_tpu.parallel import halo_exchange
+
+
+@dataclass(frozen=True)
+class UNet3DConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    block_channels: tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    attention_levels: tuple[bool, ...] = (True, True, True, False)
+    num_heads: int = 8
+    context_dim: int = 1024
+    transformer_depth: int = 1
+    temporal_kernel: int = 3
+    sp_axis: str | None = None    # mesh axis frames are sharded over
+    dtype: str = "bfloat16"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @classmethod
+    def tiny(cls, sp_axis: str | None = None) -> "UNet3DConfig":
+        return cls(block_channels=(8, 8, 8, 8), layers_per_block=1,
+                   num_heads=2, context_dim=16, sp_axis=sp_axis)
+
+
+class TemporalConv(nn.Module):
+    """Residual temporal conv; zero-init out ⇒ identity at init.
+
+    Under sp, the kernel's (k-1)/2-frame halo comes from ring neighbours;
+    edge shards see zeros — identical to the unsharded 'SAME' padding.
+    """
+    channels: int
+    kernel: int = 3
+    sp_axis: str | None = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, H, W, C]
+        h = GroupNorm32(name="norm")(x)
+        h = nn.silu(h).astype(self.dtype)
+        halo = (self.kernel - 1) // 2
+        # operate with T adjacent to channels: [B, H, W, T, C]
+        h = h.transpose(0, 2, 3, 1, 4)
+        if self.sp_axis is not None:
+            h = halo_exchange(h, self.sp_axis, axis=3, halo=halo)
+            pad = "VALID"
+        else:
+            pad = [(halo, halo)]
+        h = nn.Conv(self.channels, (self.kernel,), padding=pad,
+                    dtype=self.dtype, name="conv")(h)
+        h = nn.Conv(self.channels, (1,), dtype=self.dtype,
+                    kernel_init=nn.initializers.zeros,
+                    name="proj_out")(h)
+        return x + h.transpose(0, 3, 1, 2, 4)
+
+
+class TemporalAttention(nn.Module):
+    """Per-pixel attention across frames; zero-init out ⇒ identity at init.
+
+    With sp_axis: exact ring attention over the sharded frame axis.
+    """
+    channels: int
+    num_heads: int
+    sp_axis: str | None = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, H, W, C]
+        b, t, hh, ww, c = x.shape
+        head_dim = c // self.num_heads
+        h = GroupNorm32(name="norm")(x).astype(self.dtype)
+        # tokens: frames; batch: every spatial site → [B*H*W, heads, T, D]
+        h = h.transpose(0, 2, 3, 1, 4).reshape(b * hh * ww, t, c)
+        qkv = nn.Dense(3 * c, use_bias=False, dtype=self.dtype,
+                       name="to_qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(a):
+            return a.reshape(a.shape[0], t, self.num_heads,
+                             head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.sp_axis is not None:
+            out = ring_attention(q, k, v, axis_name=self.sp_axis)
+        else:
+            out = sp_attention_reference(q, k, v)
+        out = out.transpose(0, 2, 1, 3).reshape(b * hh * ww, t, c)
+        out = nn.Dense(c, dtype=self.dtype,
+                       kernel_init=nn.initializers.zeros,
+                       name="to_out")(out)
+        out = out.reshape(b, hh, ww, t, c).transpose(0, 3, 1, 2, 4)
+        return x + out
+
+
+class UNet3DCondition(nn.Module):
+    """eps-prediction video UNet; see module docstring for sharding."""
+    config: UNet3DConfig
+
+    def _spatial(self, fn, x):
+        """Run a 2D module over [B*T, H, W, C]."""
+        b, t = x.shape[0], x.shape[1]
+        y = fn(x.reshape(b * t, *x.shape[2:]))
+        return y.reshape(b, t, *y.shape[1:])
+
+    @nn.compact
+    def __call__(self, x, t_cond, context):
+        cfg = self.config
+        dt = cfg.jdtype
+        x = x.astype(dt)
+        b, nframes = x.shape[0], x.shape[1]
+        context = context.astype(dt)
+        # every frame of a sample shares its text context and timestep
+        ctx_rep = jnp.repeat(context, nframes, axis=0)        # [B*T, L, D]
+        temb = sinusoidal_embedding(t_cond, cfg.block_channels[0])
+        temb = TimestepEmbedding(cfg.block_channels[0] * 4, dt)(temb)
+        temb_rep = jnp.repeat(temb, nframes, axis=0)          # [B*T, E]
+
+        def res(ch, name):
+            return lambda h2d: ResnetBlock(ch, dt, name=name)(
+                h2d, temb_rep[:h2d.shape[0]])
+
+        def attn(ch, name):
+            return lambda h2d: SpatialTransformer(
+                cfg.num_heads, ch // cfg.num_heads, cfg.transformer_depth,
+                dt, name=name)(h2d, ctx_rep[:h2d.shape[0]])
+
+        h = self._spatial(
+            lambda z: nn.Conv(cfg.block_channels[0], (3, 3), padding=1,
+                              dtype=dt, name="conv_in")(z), x)
+        skips = [h]
+        for level, ch in enumerate(cfg.block_channels):
+            for j in range(cfg.layers_per_block):
+                h = self._spatial(res(ch, f"down_{level}_res_{j}"), h)
+                h = TemporalConv(ch, cfg.temporal_kernel, cfg.sp_axis, dt,
+                                 name=f"down_{level}_tconv_{j}")(h)
+                if cfg.attention_levels[level]:
+                    h = self._spatial(attn(ch, f"down_{level}_attn_{j}"), h)
+                    h = TemporalAttention(ch, cfg.num_heads, cfg.sp_axis, dt,
+                                          name=f"down_{level}_tattn_{j}")(h)
+                skips.append(h)
+            if level < len(cfg.block_channels) - 1:
+                h = self._spatial(
+                    lambda z, ch=ch, level=level: Downsample(
+                        ch, dt, name=f"down_{level}_ds")(z), h)
+                skips.append(h)
+
+        mid_ch = cfg.block_channels[-1]
+        h = self._spatial(res(mid_ch, "mid_res_0"), h)
+        h = TemporalConv(mid_ch, cfg.temporal_kernel, cfg.sp_axis, dt,
+                         name="mid_tconv")(h)
+        h = self._spatial(attn(mid_ch, "mid_attn"), h)
+        h = TemporalAttention(mid_ch, cfg.num_heads, cfg.sp_axis, dt,
+                              name="mid_tattn")(h)
+        h = self._spatial(res(mid_ch, "mid_res_1"), h)
+
+        for level in reversed(range(len(cfg.block_channels))):
+            ch = cfg.block_channels[level]
+            for j in range(cfg.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = self._spatial(res(ch, f"up_{level}_res_{j}"), h)
+                h = TemporalConv(ch, cfg.temporal_kernel, cfg.sp_axis, dt,
+                                 name=f"up_{level}_tconv_{j}")(h)
+                if cfg.attention_levels[level]:
+                    h = self._spatial(attn(ch, f"up_{level}_attn_{j}"), h)
+                    h = TemporalAttention(ch, cfg.num_heads, cfg.sp_axis, dt,
+                                          name=f"up_{level}_tattn_{j}")(h)
+            if level > 0:
+                h = self._spatial(
+                    lambda z, ch=ch, level=level: Upsample(
+                        ch, dt, name=f"up_{level}_us")(z), h)
+
+        h = self._spatial(lambda z: nn.Conv(
+            cfg.out_channels, (3, 3), padding=1, dtype=jnp.float32,
+            name="conv_out")(nn.silu(GroupNorm32(name="norm_out")(z))
+                             .astype(jnp.float32)), h)
+        return h

@@ -1,0 +1,9 @@
+"""TPU op layer: ring attention (SP) and pallas kernels.
+
+Custom compute that XLA's default lowering doesn't give us: exact
+sequence-parallel attention over a mesh axis, and (ops.flash) a pallas
+flash-attention kernel for long single-device sequences.
+"""
+from arbius_tpu.ops.ring import ring_attention, sp_attention_reference
+
+__all__ = ["ring_attention", "sp_attention_reference"]

@@ -1,0 +1,114 @@
+"""Pallas flash attention — blockwise softmax attention in VMEM.
+
+Why: the video UNet's spatial attention at zeroscope shape (1024×576 →
+latent 128×72 = 9216 tokens) materializes a 9216² f32 score matrix per
+head through the XLA einsum path (~340 MB/head-batch) — HBM-bound. The
+flash form never materializes scores: K/V stream through VMEM in blocks
+while running max/normalizer/accumulator stats (the same online-softmax
+math as ops/ring.py, one level down the memory hierarchy).
+
+Kernel layout (pallas_guide.md patterns):
+  grid = (batch*heads, Sq/BLOCK_Q); each program owns one Q block in
+  VMEM, loops over K/V blocks with fori_loop, f32 accumulators, MXU
+  matmuls via jnp.dot(preferred_element_type=f32). Shapes are padded to
+  the (8, 128) f32 tile grid; padded K positions are masked with -inf
+  before the softmax stats, so padding never changes the math.
+
+`flash_attention` is a drop-in for `sp_attention_reference` ([B, H, S, D]
+→ [B, H, S, D]); `interpret=True` runs it on CPU for tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, kv_len: int, scale: float):
+    q = q_ref[0].astype(jnp.float32)                  # [BLOCK_Q, D]
+    n_kv = k_ref.shape[1] // BLOCK_K
+
+    m0 = jnp.full((BLOCK_Q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BLOCK_Q,), jnp.float32)
+    acc0 = jnp.zeros((BLOCK_Q, q.shape[-1]), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        # mask K padding (positions >= kv_len)
+        kpos = j * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (1, BLOCK_K), 1)
+        s = jnp.where(kpos < kv_len, s, NEG_INF)
+        mb = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - mb[:, None])
+        alpha = jnp.exp(m - mb)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return mb, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    interpret: bool = False) -> jax.Array:
+    """Exact attention, flash-style. q/k/v: [B, H, S, D] → [B, H, Sq, D]."""
+    b, h, sq, d = q.shape
+    kv_len = k.shape[2]
+    scale = 1.0 / np.sqrt(d)
+
+    qf = _pad_to(_pad_to(q.reshape(b * h, sq, d), 1, BLOCK_Q), 2, 128)
+    kf = _pad_to(_pad_to(k.reshape(b * h, kv_len, d), 1, BLOCK_K), 2, 128)
+    vf = _pad_to(_pad_to(v.reshape(b * h, kv_len, d), 1, BLOCK_K), 2, 128)
+    bh, sq_p, d_p = qf.shape
+    kv_p = kf.shape[1]
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, kv_len=kv_len, scale=scale),
+        grid=(bh, sq_p // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d_p), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, kv_p, d_p), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, kv_p, d_p), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d_p), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d_p), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out[:, :sq, :d].reshape(b, h, sq, d)
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Backend-dispatching exact attention for [B, H, S, D].
+
+    TPU + long sequences → the pallas flash kernel; otherwise the XLA
+    einsum path (which XLA already fuses well at short S, and which is
+    the only compiled option off-TPU).
+    """
+    from arbius_tpu.ops.ring import sp_attention_reference
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and q.shape[2] >= 1024:
+        return flash_attention(q, k, v)
+    return sp_attention_reference(q, k, v)

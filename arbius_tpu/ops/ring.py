@@ -1,0 +1,81 @@
+"""Ring attention over a named mesh axis — sequence/context parallelism.
+
+The reference has no long-context machinery at all (SURVEY.md §2.6: max
+"sequence" is 96 video frames inside one GPU container). Here long
+sequences are first-class: shard the sequence axis over the mesh ('sp'),
+keep Q local, and rotate K/V shards around the ring with `ppermute` while
+accumulating attention in the numerically safe online-softmax form
+(flash-attention accumulation: running max m, normalizer l, weighted sum
+acc — all float32).
+
+ICI mapping: each step overlaps one K/V shard's worth of compute with one
+neighbor hop; after sp steps every query has attended to the full
+sequence without any all-gather materializing it. This is the substrate
+for UNet3D temporal attention (frame axis) and any future long-context
+model.
+
+Use inside shard_map with the sequence axis sharded over `axis_name`:
+    out = ring_attention(q, k, v, axis_name="sp")
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _attn_block(q, k, v, scale):
+    """Scores for one (local Q, one K/V shard) block; f32 softmax stats.
+
+    q: [B, H, Sq, D], k/v: [B, H, Sk, D] → (scores_max [B,H,Sq],
+    exp-weighted sum [B,H,Sq,D], normalizer [B,H,Sq])."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m, acc, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str) -> jax.Array:
+    """Exact attention over a sequence sharded on `axis_name`.
+
+    Shapes per shard: q/k/v [B, H, S_local, D]. Returns [B, H, S_local, D]
+    in q.dtype. Must run inside shard_map with `axis_name` in the mesh.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    perm = [(i, (i + 1) % n) for i in range(n)]  # pass K/V to the next rank
+
+    m0, acc0, l0 = _attn_block(q, k, v, scale)
+
+    def body(carry, _):
+        m, acc, l, k, v = carry
+        k = jax.lax.ppermute(k, axis_name, perm)
+        v = jax.lax.ppermute(v, axis_name, perm)
+        mb, accb, lb = _attn_block(q, k, v, scale)
+        m_new = jnp.maximum(m, mb)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.exp(mb - m_new)
+        acc = acc * a1[..., None] + accb * a2[..., None]
+        l = l * a1 + lb * a2
+        return (m_new, acc, l, k, v), None
+
+    if n > 1:
+        (m, acc, l, _, _), _ = jax.lax.scan(
+            body, (m0, acc0, l0, k, v), None, length=n - 1)
+    else:
+        m, acc, l = m0, acc0, l0
+    _ = idx  # rank only matters for causal variants; full attention here
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def sp_attention_reference(q, k, v):
+    """Single-device exact attention with the same f32 softmax policy —
+    the correctness oracle for ring_attention tests."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(q.dtype)

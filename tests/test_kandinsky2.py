@@ -1,0 +1,132 @@
+"""Kandinsky-2 family tests: stage shapes, end-to-end determinism, and
+dp-mesh execution — the same contract surface as the SD-1.5 suite, for the
+reference's boot-self-test model class (templates/kandinsky2.json).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from arbius_tpu.models.kandinsky2 import (
+    Kandinsky2Config,
+    Kandinsky2Pipeline,
+    MOVQConfig,
+    MOVQDecoder,
+    PriorConfig,
+    PriorTransformer,
+    prior_sample,
+)
+from arbius_tpu.models.sd15 import ByteTokenizer
+
+
+def tiny_pipe(mesh=None):
+    return Kandinsky2Pipeline(
+        Kandinsky2Config.tiny(),
+        tokenizer=ByteTokenizer(max_length=16, bos_id=257, eos_id=258),
+        mesh=mesh)
+
+
+def test_prior_transformer_shapes():
+    cfg = PriorConfig.tiny()
+    model = PriorTransformer(cfg)
+    B = 2
+    embed = jnp.zeros((B, cfg.clip_dim))
+    tok = jnp.zeros((B, cfg.text_len, cfg.clip_dim))
+    pooled = jnp.zeros((B, cfg.clip_dim))
+    params = model.init(jax.random.PRNGKey(0), embed, jnp.zeros((B,)), tok,
+                        pooled)["params"]
+    out = model.apply({"params": params}, embed, jnp.ones((B,)), tok, pooled)
+    assert out.shape == (B, cfg.clip_dim)
+    assert out.dtype == jnp.float32
+
+
+def test_prior_sample_deterministic():
+    cfg = PriorConfig.tiny()
+    model = PriorTransformer(cfg)
+    B = 2
+    tok = jnp.ones((B, cfg.text_len, cfg.clip_dim)) * 0.1
+    pooled = jnp.ones((B, cfg.clip_dim)) * 0.2
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((B, cfg.clip_dim)),
+                        jnp.zeros((B,)), tok, pooled)["params"]
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(B, dtype=jnp.uint32))
+    g = jnp.asarray([4.0, 4.0])
+    a = prior_sample(model, params, tok, pooled, keys, g, steps=3)
+    b = prior_sample(model, params, tok, pooled, keys, g, steps=3)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.isfinite(np.asarray(a)).all()
+    # different key → different embedding
+    keys2 = jax.vmap(jax.random.PRNGKey)(jnp.arange(7, 7 + B, dtype=jnp.uint32))
+    c = prior_sample(model, params, tok, pooled, keys2, g, steps=3)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_movq_upsamples_8x():
+    cfg = MOVQConfig.tiny()
+    model = MOVQDecoder(cfg)
+    z = jnp.zeros((1, 4, 4, cfg.latent_channels))
+    params = model.init(jax.random.PRNGKey(0), z)["params"]
+    out = model.apply({"params": params}, z)
+    assert out.shape == (1, 32, 32, 3)
+
+
+def test_pipeline_end_to_end_and_determinism():
+    pipe = tiny_pipe()
+    params = pipe.init_params(seed=0)
+    kw = dict(width=64, height=64, num_inference_steps=2, scheduler="DDIM")
+    a = pipe.generate(params, ["arbius test cat"], None, [1337], **kw)
+    b = pipe.generate(params, ["arbius test cat"], None, [1337], **kw)
+    assert a.shape == (1, 64, 64, 3) and a.dtype == np.uint8
+    np.testing.assert_array_equal(a, b)
+    c = pipe.generate(params, ["arbius test cat"], None, [1338], **kw)
+    assert not np.array_equal(a, c)  # seed changes bytes
+
+
+def test_pipeline_batch_content_invariance():
+    """Within one program (fixed batch size = one determinism class), a
+    sample's bytes must not depend on its batch NEIGHBORS' content — this
+    is what makes the node's pad-to-canonical-batch policy sound. (Batch
+    size itself is part of the program and may legitimately change bits;
+    the node never varies it per bucket.)"""
+    pipe = tiny_pipe()
+    params = pipe.init_params(seed=0)
+    kw = dict(width=64, height=64, num_inference_steps=2, scheduler="DDIM")
+    a = pipe.generate(params, ["cat", "dog"], None, [42, 43], **kw)
+    b = pipe.generate(params, ["cat", "wolf howling"], None, [42, 99], **kw)
+    np.testing.assert_array_equal(a[0], b[0])
+
+
+def test_pipeline_rejects_bad_geometry():
+    pipe = tiny_pipe()
+    params = pipe.init_params(seed=0)
+    with pytest.raises(ValueError, match="multiples"):
+        pipe.generate(params, ["x"], None, [1], width=60, height=64,
+                      num_inference_steps=2)
+
+
+def test_pipeline_on_dp_mesh():
+    from arbius_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
+    pipe = tiny_pipe(mesh=mesh)
+    params = pipe.place_params(pipe.init_params(seed=0))
+    out = pipe.generate(params, ["a", "b"], None, [1, 2], width=64,
+                        height=64, num_inference_steps=2, scheduler="DDIM")
+    out2 = pipe.generate(params, ["a", "b"], None, [1, 2], width=64,
+                         height=64, num_inference_steps=2, scheduler="DDIM")
+    assert out.shape == (2, 64, 64, 3)
+    # The dp program is its own determinism class (mesh layout is part of
+    # the compiled program, and the two-stage diffusion amplifies bf16
+    # partitioning differences) — the mining contract is that it is
+    # bit-stable with itself; miners pin their mesh layout fleet-wide.
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_config_consistency_checks():
+    from arbius_tpu.models.sd15.text_encoder import TextEncoderConfig
+
+    cfg = Kandinsky2Config(prior=PriorConfig.tiny(),
+                           text=TextEncoderConfig())  # width mismatch
+    with pytest.raises(ValueError, match="clip_dim"):
+        Kandinsky2Pipeline(cfg)
