@@ -23,11 +23,19 @@ from arbius_tpu.chain.fixedpoint import (
     reward,
     target_ts,
 )
+from arbius_tpu.chain.governance import (
+    GovernanceError,
+    Governor,
+    Proposal,
+    ProposalState,
+)
 from arbius_tpu.chain.token import TokenLedger
+from arbius_tpu.chain.wallet import Wallet, recover_address
 
 __all__ = [
-    "Contestation", "Engine", "EngineError", "Event", "Model", "Solution",
-    "Task", "Validator", "TokenLedger",
+    "Contestation", "Engine", "EngineError", "Event", "GovernanceError",
+    "Governor", "Model", "Proposal", "ProposalState", "Solution", "Task",
+    "Validator", "TokenLedger", "Wallet", "recover_address",
     "BASE_TOKEN_STARTING_REWARD", "STARTING_ENGINE_TOKEN_AMOUNT", "WAD",
     "diff_mul", "reward", "target_ts",
 ]

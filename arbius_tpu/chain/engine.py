@@ -115,6 +115,7 @@ class Engine:
     def __init__(self, token: TokenLedger | None = None, treasury: str = "0x" + "77" * 20,
                  start_time: int = 0):
         self.token = token or TokenLedger()
+        self.token.block_fn = lambda: self.block_number
         self.treasury = _addr(treasury)
         self.paused = False
         self.accrued_fees = 0

@@ -13,12 +13,29 @@ MAX_SUPPLY = 1_000_000 * WAD
 
 
 class TokenLedger:
+    """Balances + allowances + ERC20Votes-style delegation checkpoints.
+
+    `block_fn` supplies the current block (the Engine wires it to its own
+    block counter) so vote checkpoints are block-indexed exactly like
+    OZ ERC20Votes — the governance layer reads past votes at a proposal's
+    snapshot block.
+    """
+
     def __init__(self):
         self.balances: dict[str, int] = {}
         self.allowances: dict[tuple[str, str], int] = {}
+        self.block_fn = lambda: 0
+        self.delegates: dict[str, str] = {}
+        self._vote_ckpts: dict[str, list[tuple[int, int]]] = {}
+        self._supply_ckpts: list[tuple[int, int]] = []
+        self.total_supply = 0
 
+    # -- ERC20 -----------------------------------------------------------
     def mint(self, to: str, amount: int) -> None:
         self.balances[to] = self.balances.get(to, 0) + amount
+        self.total_supply += amount
+        self._push(self._supply_ckpts, self.total_supply)
+        self._move_votes(None, self.delegates.get(to), amount)
 
     def balance_of(self, addr: str) -> int:
         return self.balances.get(addr, 0)
@@ -32,6 +49,8 @@ class TokenLedger:
             raise ValueError("ERC20: transfer amount exceeds balance")
         self.balances[sender] = bal - amount
         self.balances[to] = self.balances.get(to, 0) + amount
+        self._move_votes(self.delegates.get(sender),
+                         self.delegates.get(to), amount)
 
     def transfer_from(self, spender: str, owner: str, to: str,
                       amount: int) -> None:
@@ -40,3 +59,46 @@ class TokenLedger:
             raise ValueError("ERC20: insufficient allowance")
         self.allowances[(owner, spender)] = allowed - amount
         self.transfer(owner, to, amount)
+
+    # -- votes (ERC20Votes subset) ---------------------------------------
+    def delegate(self, owner: str, delegatee: str) -> None:
+        prev = self.delegates.get(owner)
+        self.delegates[owner] = delegatee
+        self._move_votes(prev, delegatee, self.balance_of(owner))
+
+    def _push(self, ckpts: list, value: int) -> None:
+        block = self.block_fn()
+        if ckpts and ckpts[-1][0] == block:
+            ckpts[-1] = (block, value)
+        else:
+            ckpts.append((block, value))
+
+    def _move_votes(self, src: str | None, dst: str | None,
+                    amount: int) -> None:
+        if amount == 0 or src == dst:
+            return
+        if src is not None:
+            ck = self._vote_ckpts.setdefault(src, [])
+            self._push(ck, (ck[-1][1] if ck else 0) - amount)
+        if dst is not None:
+            ck = self._vote_ckpts.setdefault(dst, [])
+            self._push(ck, (ck[-1][1] if ck else 0) + amount)
+
+    @staticmethod
+    def _at_block(ckpts: list[tuple[int, int]], block: int) -> int:
+        value = 0
+        for b, v in ckpts:
+            if b > block:
+                break
+            value = v
+        return value
+
+    def get_votes(self, addr: str) -> int:
+        ck = self._vote_ckpts.get(addr, [])
+        return ck[-1][1] if ck else 0
+
+    def get_past_votes(self, addr: str, block: int) -> int:
+        return self._at_block(self._vote_ckpts.get(addr, []), block)
+
+    def past_total_supply(self, block: int) -> int:
+        return self._at_block(self._supply_ckpts, block)

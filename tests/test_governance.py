@@ -1,0 +1,157 @@
+"""Governance tests — mirror of the reference's governance.test.ts flow:
+delegate → propose → vote → queue (timelock) → execute, quorum math, and
+the setSolutionMineableRate-via-governance scenario (SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import pytest
+
+from arbius_tpu.chain import Engine, TokenLedger, WAD
+from arbius_tpu.chain.governance import (
+    Governor,
+    GovernanceError,
+    ProposalState,
+    TIMELOCK_MIN_DELAY,
+    VOTING_DELAY,
+    VOTING_PERIOD,
+)
+
+ALICE = "0x" + "a1" * 20
+BOB = "0x" + "b2" * 20
+CAROL = "0x" + "c3" * 20
+
+
+def world():
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=1000)
+    gov = Governor(eng)
+    tok.mint(ALICE, 100_000 * WAD)
+    tok.mint(BOB, 50_000 * WAD)
+    tok.mint(CAROL, 10_000 * WAD)
+    for a in (ALICE, BOB, CAROL):
+        tok.delegate(a, a)      # self-delegate, as governance.test.ts does
+    eng.advance_time(10, 1)     # checkpoints land before any snapshot
+    return eng, tok, gov
+
+
+def pass_proposal(eng, gov, pid, voters=(ALICE, BOB)):
+    eng.advance_time(0, VOTING_DELAY + 1)
+    for v in voters:
+        gov.cast_vote(v, pid, 1)
+    eng.advance_time(0, VOTING_PERIOD)
+    gov.queue(pid)
+    eng.advance_time(TIMELOCK_MIN_DELAY + 1)
+    gov.execute(pid)
+
+
+def test_delegation_checkpoints():
+    eng, tok, gov = world()
+    assert tok.get_votes(ALICE) == 100_000 * WAD
+    block = eng.block_number
+    eng.advance_time(0, 5)
+    tok.transfer(ALICE, BOB, 40_000 * WAD)
+    assert tok.get_votes(ALICE) == 60_000 * WAD
+    assert tok.get_votes(BOB) == 90_000 * WAD
+    # history preserved at the earlier block
+    assert tok.get_past_votes(ALICE, block) == 100_000 * WAD
+
+
+def test_full_lifecycle_executes_action():
+    eng, tok, gov = world()
+    fired = []
+    pid = gov.propose(ALICE, [lambda: fired.append("treasury-move")],
+                      "move treasury funds")
+    assert gov.state(pid) == ProposalState.PENDING
+    eng.advance_time(0, VOTING_DELAY + 1)
+    assert gov.state(pid) == ProposalState.ACTIVE
+    gov.cast_vote(ALICE, pid, 1)
+    gov.cast_vote(BOB, pid, 1)
+    eng.advance_time(0, VOTING_PERIOD)
+    assert gov.state(pid) == ProposalState.SUCCEEDED
+    gov.queue(pid)
+    assert gov.state(pid) == ProposalState.QUEUED
+    with pytest.raises(GovernanceError, match="timelock"):
+        gov.execute(pid)
+    eng.advance_time(TIMELOCK_MIN_DELAY + 1)
+    gov.execute(pid)
+    assert fired == ["treasury-move"]
+    assert gov.state(pid) == ProposalState.EXECUTED
+
+
+def test_quorum_4_percent():
+    """Carol alone (10k of 160k = 6.25%) meets quorum; a tiny voter does
+    not (OZ GovernorVotesQuorumFraction(4))."""
+    eng, tok, gov = world()
+    pid = gov.propose(ALICE, [lambda: None], "carol only")
+    eng.advance_time(0, VOTING_DELAY + 1)
+    gov.cast_vote(CAROL, pid, 1)
+    eng.advance_time(0, VOTING_PERIOD)
+    assert gov.state(pid) == ProposalState.SUCCEEDED
+
+    tiny = "0x" + "d4" * 20
+    tok.mint(tiny, 100 * WAD)
+    tok.delegate(tiny, tiny)
+    eng.advance_time(0, 1)
+    pid2 = gov.propose(ALICE, [lambda: None], "tiny only")
+    eng.advance_time(0, VOTING_DELAY + 1)
+    gov.cast_vote(tiny, pid2, 1)
+    eng.advance_time(0, VOTING_PERIOD)
+    assert gov.state(pid2) == ProposalState.DEFEATED
+
+
+def test_against_votes_defeat():
+    eng, tok, gov = world()
+    pid = gov.propose(ALICE, [lambda: None], "contested")
+    eng.advance_time(0, VOTING_DELAY + 1)
+    gov.cast_vote(BOB, pid, 1)       # 50k for
+    gov.cast_vote(ALICE, pid, 0)     # 100k against
+    eng.advance_time(0, VOTING_PERIOD)
+    assert gov.state(pid) == ProposalState.DEFEATED
+    with pytest.raises(GovernanceError, match="not successful"):
+        gov.queue(pid)
+
+
+def test_proposal_threshold():
+    eng, tok, gov = world()
+    pauper = "0x" + "e5" * 20
+    with pytest.raises(GovernanceError, match="threshold"):
+        gov.propose(pauper, [lambda: None], "no stake no say")
+
+
+def test_no_double_vote_and_snapshot_weights():
+    """Votes use the SNAPSHOT block weight: tokens acquired after the
+    snapshot don't count (vote-buying defense, same spirit as the
+    engine's stake-age gate)."""
+    eng, tok, gov = world()
+    pid = gov.propose(ALICE, [lambda: None], "snapshot rules")
+    eng.advance_time(0, VOTING_DELAY + 1)
+    gov.cast_vote(CAROL, pid, 1)
+    with pytest.raises(GovernanceError, match="already voted"):
+        gov.cast_vote(CAROL, pid, 1)
+    # BOB ships tokens to CAROL after the snapshot; CAROL already voted
+    # with 10k and BOB's vote still carries his snapshot weight
+    tok.transfer(BOB, CAROL, 50_000 * WAD)
+    w = gov.cast_vote(BOB, pid, 1)
+    assert w == 50_000 * WAD
+
+
+def test_mineable_rate_via_governance():
+    """governance.test.ts:128-444 headline: setSolutionMineableRate goes
+    through propose → vote → queue → execute, then affects claims."""
+    eng, tok, gov = world()
+    mid = eng.register_model(ALICE, BOB, 0, b'{"meta":{"title":"gov"}}')
+    assert eng.models[mid].rate == 0
+    pid = gov.propose(
+        ALICE, [lambda: eng.set_solution_mineable_rate(mid, WAD // 10)],
+        "set kandinsky2 mineable rate to 0.1")
+    pass_proposal(eng, gov, pid)
+    assert eng.models[mid].rate == WAD // 10
+
+
+def test_description_cid_stored():
+    eng, tok, gov = world()
+    pid = gov.propose(ALICE, [lambda: None], "ipfs me")
+    p = gov.proposals[pid]
+    from arbius_tpu.l0.cid import cid_onchain
+    assert p.description_cid == cid_onchain(b"ipfs me")
+    assert gov.proposals_created == [pid]

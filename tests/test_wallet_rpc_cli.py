@@ -1,0 +1,168 @@
+"""Wallet (secp256k1/ECDSA), control RPC, and CLI tests."""
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from arbius_tpu.chain.wallet import Wallet, recover_address
+from arbius_tpu.l0.keccak import keccak256
+
+
+# -- wallet ----------------------------------------------------------------
+
+def test_known_key_address():
+    """Golden vector: the universally known hardhat/test key #0."""
+    w = Wallet.from_hex(
+        "0xac0974bec39a17e36ba4a6b4d238ff944bacb478cbed5efcae784d7bf4f2ff80")
+    assert w.address == "0xf39fd6e51aad88f6f4ce6ab8827279cfffb92266"
+
+
+def test_generate_and_roundtrip():
+    w = Wallet.generate()
+    assert len(w.private_key) == 32
+    assert w.address.startswith("0x") and len(w.address) == 42
+    assert Wallet.from_hex("0x" + w.private_key.hex()).address == w.address
+
+
+def test_sign_recover():
+    w = Wallet.from_hex("0x" + "11" * 32)
+    h = keccak256(b"arbius solve commitment")
+    r, s, rec = w.sign(h)
+    assert recover_address(h, r, s, rec) == w.address
+    # deterministic (RFC 6979): same hash, same signature
+    assert w.sign(h) == (r, s, rec)
+    # low-s normalization (EIP-2)
+    from arbius_tpu.chain.wallet import N
+    assert s <= N // 2
+
+
+def test_sign_message_eip191():
+    w = Wallet.from_hex("0x" + "22" * 32)
+    r, s, rec = w.sign_message(b"hello")
+    prefixed = b"\x19Ethereum Signed Message:\n5hello"
+    assert recover_address(keccak256(prefixed), r, s, rec) == w.address
+
+
+def test_bad_keys_rejected():
+    with pytest.raises(ValueError):
+        Wallet.from_hex("0x00")
+    with pytest.raises(ValueError):
+        Wallet.from_hex("0x" + "00" * 32)  # zero key
+
+
+# -- control rpc -----------------------------------------------------------
+
+@pytest.fixture
+def rpc_node():
+    from arbius_tpu.node import MinerNode, MiningConfig, ModelRegistry
+    from arbius_tpu.node.rpc import ControlRPC
+    from arbius_tpu.chain import Engine, TokenLedger, WAD
+    from arbius_tpu.node.chain_client import LocalChain
+
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=0)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    miner = "0x" + "aa" * 20
+    tok.mint(miner, 100 * WAD)
+    tok.approve(miner, Engine.ADDRESS, 10**30)
+    node = MinerNode(LocalChain(eng, miner), MiningConfig(), ModelRegistry())
+    node.boot()
+    rpc = ControlRPC(node, port=0)
+    rpc.start()
+    yield node, rpc
+    rpc.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_rpc_job_lifecycle(rpc_node):
+    node, rpc = rpc_node
+    created = _post(rpc.port, "/api/jobs/queue",
+                    {"method": "automine", "data": {}, "priority": 7})
+    jobs = _get(rpc.port, "/api/jobs/get")
+    assert any(j["id"] == created["id"] and j["method"] == "automine"
+               for j in jobs)
+    _post(rpc.port, "/api/jobs/delete", {"id": created["id"]})
+    jobs = _get(rpc.port, "/api/jobs/get")
+    assert not any(j["id"] == created["id"] for j in jobs)
+
+
+def test_rpc_metrics(rpc_node):
+    node, rpc = rpc_node
+    m = _get(rpc.port, "/api/metrics")
+    assert m["solutions_submitted"] == 0
+    assert "queue_depth" in m and "solve_latency_p50" in m
+
+
+def test_rpc_bad_requests(rpc_node):
+    _, rpc = rpc_node
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(rpc.port, "/api/jobs/queue", {"data": {}})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(rpc.port, "/api/nope")
+    assert e.value.code == 404
+
+
+# -- cli -------------------------------------------------------------------
+
+def test_cli_wallet_gen(capsys):
+    from arbius_tpu.cli import main
+
+    assert main(["wallet-gen"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["address"].startswith("0x")
+
+
+def test_cli_templates_and_inspect(capsys):
+    from arbius_tpu.cli import main
+
+    assert main(["templates"]) == 0
+    assert "anythingv3" in capsys.readouterr().out
+    assert main(["template", "kandinsky2"]) == 0
+    t = json.loads(capsys.readouterr().out)
+    assert any(i["variable"] == "prompt" for i in t["inputs"])
+
+
+def test_cli_emission(capsys):
+    from arbius_tpu.cli import main
+
+    assert main(["emission", "--t", "31536000", "--supply", "100000"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["targetTs"] == 300000.0
+    assert out["diffMul"] == 100.0
+
+
+def test_cli_validate_config(tmp_path, capsys):
+    from arbius_tpu.cli import main
+
+    good = tmp_path / "good.json"
+    good.write_text('{"db_path": ":memory:"}')
+    assert main(["validate-config", str(good)]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"nope": 1}')
+    assert main(["validate-config", str(bad)]) == 1
+
+
+def test_cli_cid(tmp_path, capsys):
+    from arbius_tpu.cli import main
+
+    f = tmp_path / "x.bin"
+    f.write_bytes(b"hello world")
+    assert main(["cid", str(f)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["cid"].startswith("Qm")
