@@ -1,0 +1,189 @@
+"""arbius-tpu CLI — ops tooling (L4').
+
+Parity targets from the reference's hardhat task suite
+(`contract/tasks/index.ts:12-465`) reinterpreted for the in-process stack:
+
+  wallet-gen        gen-wallet: new private key + address
+  templates         list bundled model templates
+  template <name>   inspect a template's schema
+  validate-config   parse + schema-check a MiningConfig.json
+  cid <file>        L0 CID of a file's bytes (generateIPFSCID parity)
+  commitment        generateCommitment(address, taskid, cid)
+  emission          targetTs/diffMul/reward table for a time/supply
+  demo-mine         end-to-end local mine: fake chain + tiny SD-1.5,
+                    task → solve → commit → reveal → claim (the §3.2
+                    money path, observable in one command)
+
+Run: python -m arbius_tpu.cli <command> [...args]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_wallet_gen(args) -> int:
+    from arbius_tpu.chain.wallet import Wallet
+
+    w = Wallet.generate()
+    print(json.dumps({"address": w.address,
+                      "privateKey": "0x" + w.private_key.hex()}))
+    return 0
+
+
+def cmd_templates(args) -> int:
+    from arbius_tpu.templates.engine import load_template, template_names
+
+    for name in template_names():
+        t = load_template(name)
+        print(f"{name}: {t.title} -> "
+              f"{', '.join(o.filename for o in t.outputs)}")
+    return 0
+
+
+def cmd_template(args) -> int:
+    from arbius_tpu.templates.engine import load_template
+
+    t = load_template(args.name)
+    print(json.dumps({
+        "title": t.title,
+        "inputs": [{"variable": f.variable, "type": f.type,
+                    "required": f.required, "default": f.default}
+                   for f in t.inputs],
+        "outputs": [{"filename": o.filename, "type": o.type}
+                    for o in t.outputs],
+    }, indent=2))
+    return 0
+
+
+def cmd_validate_config(args) -> int:
+    from arbius_tpu.node.config import ConfigError, load_config
+
+    try:
+        cfg = load_config(open(args.path).read())
+    except (OSError, json.JSONDecodeError, ConfigError) as e:
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    print(f"OK: {len(cfg.models)} model(s), automine="
+          f"{cfg.automine.enabled}, db={cfg.db_path}")
+    return 0
+
+
+def cmd_cid(args) -> int:
+    from arbius_tpu.l0.cid import cid_base58, cid_hex, dag_of_file
+
+    data = open(args.path, "rb").read()
+    node = dag_of_file(data)
+    print(json.dumps({"cid": cid_base58(node.cid),
+                      "hex": cid_hex(node.cid), "size": len(data)}))
+    return 0
+
+
+def cmd_commitment(args) -> int:
+    from arbius_tpu.l0.commitment import generate_commitment_hex
+
+    print(generate_commitment_hex(args.address, args.taskid, args.cid))
+    return 0
+
+
+def cmd_emission(args) -> int:
+    from arbius_tpu.chain.fixedpoint import WAD, diff_mul, reward, target_ts
+
+    t = args.t
+    ts = int(args.supply * WAD)
+    out = {"t": t, "targetTs": target_ts(t) / WAD}
+    if ts > 0 and t > 0:
+        out["diffMul"] = diff_mul(t, ts) / WAD
+        out["reward"] = reward(t, ts) / WAD
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_demo_mine(args) -> int:
+    from arbius_tpu.chain import Engine, TokenLedger, WAD
+    from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
+    from arbius_tpu.node import (
+        LocalChain,
+        MinerNode,
+        MiningConfig,
+        ModelConfig,
+        ModelRegistry,
+        RegisteredModel,
+        SD15Runner,
+    )
+    from arbius_tpu.templates.engine import load_template
+
+    miner, user = "0x" + "aa" * 20, "0x" + "01" * 20
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=0)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    for a in (miner, user):
+        tok.mint(a, 1000 * WAD)
+        tok.approve(a, Engine.ADDRESS, 10**30)
+    mid_b = eng.register_model(user, user, 0, b'{"meta":{"title":"demo"}}')
+    print(f"model registered: 0x{mid_b.hex()}")
+
+    pipe = SD15Pipeline(SD15Config.tiny(),
+                        tokenizer=ByteTokenizer(max_length=16, bos_id=257,
+                                                eos_id=258))
+    params = pipe.init_params(seed=0)
+    reg = ModelRegistry()
+    reg.register(RegisteredModel(id="0x" + mid_b.hex(),
+                                 template=load_template("anythingv3"),
+                                 runner=SD15Runner(pipe, params)))
+    chain = LocalChain(eng, miner)
+    chain.validator_deposit(100 * WAD)
+    node = MinerNode(chain, MiningConfig(
+        models=(ModelConfig(id="0x" + mid_b.hex(),
+                            template="anythingv3"),)), reg)
+    node.boot()
+
+    tid = eng.submit_task(user, 0, user, mid_b, 0, json.dumps({
+        "prompt": args.prompt, "negative_prompt": "", "width": 128,
+        "height": 128, "num_inference_steps": 2,
+        "scheduler": "DDIM"}).encode())
+    print(f"task submitted: 0x{tid.hex()}")
+    while node.tick():
+        pass
+    sol = eng.solutions[tid]
+    print(f"solution by {sol.validator}: cid 0x{sol.cid.hex()}")
+    eng.advance_time(2200)
+    while node.tick():
+        pass
+    print(f"claimed: {node.metrics.solutions_claimed == 1}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="arbius-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("wallet-gen").set_defaults(fn=cmd_wallet_gen)
+    sub.add_parser("templates").set_defaults(fn=cmd_templates)
+    sp = sub.add_parser("template")
+    sp.add_argument("name")
+    sp.set_defaults(fn=cmd_template)
+    sp = sub.add_parser("validate-config")
+    sp.add_argument("path")
+    sp.set_defaults(fn=cmd_validate_config)
+    sp = sub.add_parser("cid")
+    sp.add_argument("path")
+    sp.set_defaults(fn=cmd_cid)
+    sp = sub.add_parser("commitment")
+    sp.add_argument("address")
+    sp.add_argument("taskid")
+    sp.add_argument("cid")
+    sp.set_defaults(fn=cmd_commitment)
+    sp = sub.add_parser("emission")
+    sp.add_argument("--t", type=int, default=31536000)
+    sp.add_argument("--supply", type=float, default=100000.0)
+    sp.set_defaults(fn=cmd_emission)
+    sp = sub.add_parser("demo-mine")
+    sp.add_argument("--prompt", default="arbius test cat")
+    sp.set_defaults(fn=cmd_demo_mine)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,17 +17,21 @@ from arbius_tpu.node.db import Job, NodeDB
 from arbius_tpu.node.node import BootError, MinerNode, NodeMetrics
 from arbius_tpu.node.retry import RetriesExhausted, expretry
 from arbius_tpu.node.solver import (
+    Kandinsky2Runner,
     ModelRegistry,
     RegisteredModel,
+    RVMRunner,
     SD15Runner,
+    Text2VideoRunner,
     solve_cid,
     solve_files,
 )
 
 __all__ = [
-    "AutomineConfig", "BootError", "ConfigError", "Job", "LocalChain",
-    "MinerNode", "MiningConfig", "ModelConfig", "ModelRegistry",
-    "NodeDB", "NodeMetrics", "RegisteredModel", "RetriesExhausted",
-    "SD15Runner", "StakeConfig", "expretry", "load_config", "solve_cid",
+    "AutomineConfig", "BootError", "ConfigError", "Job",
+    "Kandinsky2Runner", "LocalChain", "MinerNode", "MiningConfig",
+    "ModelConfig", "ModelRegistry", "NodeDB", "NodeMetrics", "RVMRunner",
+    "RegisteredModel", "RetriesExhausted", "SD15Runner", "StakeConfig",
+    "Text2VideoRunner", "expretry", "load_config", "solve_cid",
     "solve_files",
 ]

@@ -56,6 +56,9 @@ class MiningConfig:
     stake: StakeConfig = StakeConfig()
     claim_delay_buffer: int = 120  # claim at solution+minClaimTime+this
     poll_interval_ms: int = 100    # main-loop cadence (index.ts:1082-1096)
+    # dp batch per solve dispatch; MUST be fleet-wide per model class
+    # (batch size is part of the XLA program = the determinism class)
+    canonical_batch: int = 1
 
 
 _KNOWN = {f for f in MiningConfig.__dataclass_fields__}

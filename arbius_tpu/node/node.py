@@ -269,7 +269,8 @@ class MinerNode:
             try:
                 results = solve_cid_batch(
                     m, [(h, h["seed"]) for _, h in entries],
-                    evilmode=self.config.evilmode)
+                    evilmode=self.config.evilmode,
+                    canonical_batch=self.config.canonical_batch)
             except Exception as e:  # noqa: BLE001 — whole bucket failed
                 log.warning("bucket solve failed: %r", e)
                 for job, _ in entries:

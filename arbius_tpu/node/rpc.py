@@ -1,0 +1,101 @@
+"""Operator control RPC — job injection + introspection + metrics.
+
+Mirror of the reference's express API (`miner/src/rpc.ts:15-95`:
+/api/jobs/queue, /api/jobs/get, /api/jobs/delete) plus the metrics
+endpoint the reference lacks (SURVEY.md §5 observability: solutions/hour,
+latency percentiles, queue depth). stdlib http.server, localhost-bound —
+this is an operator-only surface, exactly like the reference's.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+class ControlRPC:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet; node logging covers it
+                pass
+
+            def _send(self, code: int, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/api/jobs/get":
+                    jobs = outer.node.db.get_jobs(now=2**62)
+                    self._send(200, [{
+                        "id": j.id, "method": j.method, "priority": j.priority,
+                        "waituntil": j.waituntil, "concurrent": j.concurrent,
+                        "data": j.data} for j in jobs])
+                elif self.path == "/api/metrics":
+                    self._send(200, outer.metrics())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "bad json"})
+                    return
+                if self.path == "/api/jobs/queue":
+                    try:
+                        job_id = outer.node.db.queue_job(
+                            body["method"], body.get("data", {}),
+                            priority=int(body.get("priority", 0)),
+                            waituntil=int(body.get("waituntil", 0)),
+                            concurrent=bool(body.get("concurrent", False)))
+                    except KeyError:
+                        self._send(400, {"error": "method required"})
+                        return
+                    self._send(200, {"id": job_id})
+                elif self.path == "/api/jobs/delete":
+                    try:
+                        outer.node.db.delete_job(int(body["id"]))
+                    except (KeyError, ValueError):
+                        self._send(400, {"error": "id required"})
+                        return
+                    self._send(200, {"ok": True})
+                else:
+                    self._send(404, {"error": "not found"})
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def metrics(self) -> dict:
+        m = self.node.metrics
+        lat = [s for _, s in m.solve_latency]
+        return {
+            "tasks_seen": m.tasks_seen,
+            "tasks_invalid": m.tasks_invalid,
+            "solutions_submitted": m.solutions_submitted,
+            "solutions_claimed": m.solutions_claimed,
+            "contestations_submitted": m.contestations_submitted,
+            "votes_cast": m.votes_cast,
+            "queue_depth": self.node.db.job_count(),
+            "solve_latency_p50": float(np.median(lat)) if lat else None,
+            "solve_latency_p95": float(np.percentile(lat, 95)) if lat else None,
+        }
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

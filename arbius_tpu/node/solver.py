@@ -63,16 +63,30 @@ def solve_files(model: RegisteredModel, hydrated: dict, seed: int) -> dict:
     return _check_declared(model, model.runner(hydrated, seed))
 
 
-def solve_files_batch(model: RegisteredModel,
-                      items: list[tuple[dict, int]]) -> list[dict]:
-    """Batched inference over one shape bucket: a single XLA dispatch when
-    the runner supports it (`run_batch`), else a per-item loop. Output
-    bytes are identical either way — the pipeline pads buckets to a
-    canonical batch, so batch size never changes a sample's bits."""
+def solve_files_batch(model: RegisteredModel, items: list[tuple[dict, int]],
+                      *, canonical_batch: int = 1) -> list[dict]:
+    """Batched inference over one shape bucket, ALWAYS at the canonical
+    batch size.
+
+    Batch size is part of the compiled XLA program, and different programs
+    are different determinism classes — if miners ran whatever batch their
+    queue happened to hold, two honest nodes could emit different bytes
+    for the same task and contest each other. So every dispatch is padded
+    to exactly `canonical_batch` samples (repeating the last real item)
+    and one bucket ⇒ one program ⇒ one determinism class. Runners without
+    `run_batch` are the canonical_batch=1 case by construction.
+    """
     run_batch = getattr(model.runner, "run_batch", None)
-    if run_batch is not None and len(items) > 1:
-        return [_check_declared(model, f) for f in run_batch(items)]
-    return [solve_files(model, h, s) for h, s in items]
+    if run_batch is None or canonical_batch <= 1:
+        return [solve_files(model, h, s) for h, s in items]
+    out: list[dict] = []
+    for start in range(0, len(items), canonical_batch):
+        chunk = items[start:start + canonical_batch]
+        real = len(chunk)
+        chunk = chunk + [chunk[-1]] * (canonical_batch - real)
+        files = run_batch(chunk)
+        out.extend(_check_declared(model, f) for f in files[:real])
+    return out
 
 
 EVIL_CID = ("0x1220000000000000000000000000000000000000000000000000000000000"
@@ -91,14 +105,112 @@ def solve_cid(model: RegisteredModel, hydrated: dict, seed: int,
 
 
 def solve_cid_batch(model: RegisteredModel, items: list[tuple[dict, int]],
-                    *, evilmode: bool = False) -> list[tuple[str, dict]]:
+                    *, evilmode: bool = False,
+                    canonical_batch: int = 1) -> list[tuple[str, dict]]:
     """Batched solve_cid over one shape bucket."""
     if evilmode:
         return [(EVIL_CID, {})] * len(items)
     out = []
-    for files in solve_files_batch(model, items):
+    for files in solve_files_batch(model, items,
+                                   canonical_batch=canonical_batch):
         out.append((cid_hex(cid_of_solution_files(files)), files))
     return out
+
+
+class Kandinsky2Runner:
+    """kandinsky2-template runner: prior+decoder+MOVQ → deterministic PNG.
+
+    Template variables (templates/kandinsky2.json): prompt,
+    width/height ∈ {768, 1024}; output out-1.png. The reference's only
+    enabled + boot-self-test model (miner/src/index.ts:844-877).
+    """
+
+    def __init__(self, pipeline, params, out_name: str = "out-1.png"):
+        self.pipeline = pipeline
+        self.params = params
+        self.out_name = out_name
+
+    def __call__(self, hydrated: dict, seed: int) -> dict:
+        return self.run_batch([(hydrated, seed)])[0]
+
+    def run_batch(self, items: list[tuple[dict, int]]) -> list[dict]:
+        first = items[0][0]
+        images = self.pipeline.generate(
+            self.params,
+            prompts=[h["prompt"] for h, _ in items],
+            negative_prompts=None,
+            seeds=[s for _, s in items],
+            width=int(first.get("width", 768)),
+            height=int(first.get("height", 768)),
+            num_inference_steps=int(first.get("num_inference_steps", 50)),
+            guidance_scale=[float(h.get("guidance_scale", 4.0))
+                            for h, _ in items],
+        )
+        return [{self.out_name: encode_png(np.asarray(images[i]))}
+                for i in range(len(items))]
+
+
+class Text2VideoRunner:
+    """zeroscope/damo-template runner: UNet3D → deterministic MJPEG MP4.
+
+    Template variables (templates/zeroscopev2xl.json / damo.json): prompt,
+    negative_prompt (zeroscope), num_frames, num_inference_steps,
+    width/height enums, guidance_scale, fps; output out-1.mp4.
+    """
+
+    def __init__(self, pipeline, params, out_name: str = "out-1.mp4",
+                 defaults: dict | None = None):
+        self.pipeline = pipeline
+        self.params = params
+        self.out_name = out_name
+        self.defaults = {"num_frames": 16, "width": 256, "height": 256,
+                         "num_inference_steps": 20, "guidance_scale": 9.0,
+                         "fps": 8, **(defaults or {})}
+
+    def __call__(self, hydrated: dict, seed: int) -> dict:
+        from arbius_tpu.codecs import encode_mp4
+
+        d = self.defaults
+        g = lambda k: hydrated.get(k) if hydrated.get(k) is not None else d[k]
+        frames = self.pipeline.generate(
+            self.params,
+            prompts=[hydrated["prompt"]],
+            negative_prompts=[hydrated.get("negative_prompt", "")],
+            seeds=[seed],
+            num_frames=int(g("num_frames")),
+            width=int(g("width")), height=int(g("height")),
+            num_inference_steps=int(g("num_inference_steps")),
+            guidance_scale=float(g("guidance_scale")),
+        )
+        return {self.out_name: encode_mp4(frames[0], fps=int(g("fps")))}
+
+
+class RVMRunner:
+    """robust_video_matting-template runner: ConvGRU matting stream.
+
+    The template's `input_video` is a file reference; `resolve_file`
+    (cid/url → bytes) is injected — the reference fetched from IPFS, a
+    local deployment may read a content store. Output composition follows
+    the output_type enum. Seed-independent, like the reference model.
+    """
+
+    def __init__(self, pipeline, params, resolve_file,
+                 out_name: str = "out-1.mp4", fps: int = 8):
+        self.pipeline = pipeline
+        self.params = params
+        self.resolve_file = resolve_file
+        self.out_name = out_name
+        self.fps = fps
+
+    def __call__(self, hydrated: dict, seed: int) -> dict:
+        from arbius_tpu.codecs import encode_mp4
+        from arbius_tpu.codecs.mp4_demux import decode_mjpeg_mp4
+
+        video = decode_mjpeg_mp4(self.resolve_file(hydrated["input_video"]))
+        out = self.pipeline.matte(
+            self.params, video,
+            output_type=hydrated.get("output_type", "green-screen"))
+        return {self.out_name: encode_mp4(out, fps=self.fps)}
 
 
 class SD15Runner:

@@ -314,9 +314,11 @@ def test_solve_jobs_batch_into_one_dispatch():
     m = node.registry.get(mid)
     node.registry.register(RegisteredModel(id=mid, template=m.template,
                                            runner=BatchRunner()))
+    node.config = MiningConfig(models=node.config.models, canonical_batch=4)
     tids = [submit(eng, mid, prompt=f"p{i}") for i in range(3)]
     drain(node)
-    assert batches == [3]
+    # one dispatch, padded to the canonical batch (3 real + 1 pad)
+    assert batches == [4]
     for tid in tids:
         assert bytes.fromhex(tid[2:]) in eng.solutions
 
