@@ -1,0 +1,1 @@
+"""Runnable minimal examples (reference Example/*.sol parity)."""

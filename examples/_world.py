@@ -1,0 +1,44 @@
+"""Shared scaffolding for the examples: a funded fake chain.
+
+Every example gets the same deployment: engine seeded with the 600k
+emission pool, three funded accounts, and (optionally) staked validators —
+the same bootstrap the reference's hardhat fixtures perform.
+"""
+from __future__ import annotations
+
+from arbius_tpu.chain import Engine, TokenLedger, WAD
+
+DEPLOYER = "0x" + "d0" * 20
+USER = "0x" + "01" * 20
+VALIDATOR = "0x" + "11" * 20
+VALIDATOR2 = "0x" + "12" * 20
+MODEL_FEE_ADDR = "0x" + "33" * 20
+
+TEMPLATE = b'{"meta":{"title":"example model (TPU)"}}'
+
+
+def make_world(*, engine_balance=600_000 * WAD, staked=()):
+    token = TokenLedger()
+    # nonzero start time: a validator whose `since` is 0 is treated as
+    # never-staked by the vote gate (EngineV1.sol:966-970)
+    engine = Engine(token, start_time=1_000)
+    token.mint(Engine.ADDRESS, engine_balance)
+    for a in (DEPLOYER, USER, VALIDATOR, VALIDATOR2):
+        token.mint(a, 1_000 * WAD)
+        token.approve(a, Engine.ADDRESS, 10**30)
+    for v in staked:
+        engine.validator_deposit(v, v, 100 * WAD)
+    return engine, token
+
+
+def deploy_model(engine, fee=0):
+    return engine.register_model(DEPLOYER, MODEL_FEE_ADDR, fee, TEMPLATE)
+
+
+def solve_task(engine, taskid, validator=VALIDATOR,
+               cid=b"\x12\x20" + b"\xaa" * 32):
+    com = engine.generate_commitment(validator, taskid, cid)
+    engine.signal_commitment(validator, com)
+    engine.mine_block()
+    engine.submit_solution(validator, taskid, cid)
+    return cid

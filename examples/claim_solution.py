@@ -1,0 +1,19 @@
+"""ClaimSolution.sol parity: claim after the delay; fees split 90/10."""
+from arbius_tpu.chain import WAD
+from examples._world import USER, VALIDATOR, deploy_model, make_world, solve_task
+
+
+def main():
+    engine, token = make_world(staked=(VALIDATOR,))
+    mid = deploy_model(engine)
+    tid = engine.submit_task(USER, 0, USER, mid, 10 * WAD, b"{}")
+    solve_task(engine, tid)
+    engine.advance_time(2_001)
+    before = token.balance_of(VALIDATOR)
+    engine.claim_solution(USER, tid)  # anyone may claim; solver is paid
+    print(f"solver earned: {(token.balance_of(VALIDATOR) - before) / WAD} "
+          f"AIUS; treasury accrued: {engine.accrued_fees / WAD}")
+
+
+if __name__ == "__main__":
+    main()

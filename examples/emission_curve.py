@@ -1,0 +1,18 @@
+"""Emission schedule over the first years (simulate_rewards parity)."""
+from arbius_tpu.chain import WAD, diff_mul, reward, target_ts
+
+YEAR = 31_536_000
+
+
+def main():
+    print(f"{'year':>5} {'targetTs':>12} {'diffMul@half':>12} {'reward@half':>12}")
+    for years in (0.5, 1, 2, 4, 8):
+        t = int(years * YEAR)
+        ts = target_ts(t) // 2  # supply running at half target
+        row = (years, target_ts(t) / WAD, diff_mul(t, ts) / WAD,
+               reward(t, ts) / WAD)
+        print(f"{row[0]:>5} {row[1]:>12.0f} {row[2]:>12.2f} {row[3]:>12.4f}")
+
+
+if __name__ == "__main__":
+    main()

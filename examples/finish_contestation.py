@@ -1,0 +1,22 @@
+"""FinishContestationVote.sol parity: paginated payout after the period."""
+from arbius_tpu.chain import WAD
+from examples._world import (USER, VALIDATOR, VALIDATOR2, deploy_model,
+                             make_world, solve_task)
+
+
+def main():
+    engine, token = make_world(engine_balance=597_000 * WAD,
+                               staked=(VALIDATOR, VALIDATOR2))
+    mid = deploy_model(engine)
+    tid = engine.submit_task(USER, 0, USER, mid, 0, b"{}")
+    solve_task(engine, tid, VALIDATOR)
+    engine.submit_contestation(VALIDATOR2, tid)
+    engine.advance_time(4_000)
+    engine.contestation_vote_finish(USER, tid, 10)
+    # tie (1 yea vs 1 nay) sides with nays: the solution stood
+    print(f"finish_start_index={engine.contestations[tid].finish_start_index}"
+          f"; accused refunded + paid via the claim path")
+
+
+if __name__ == "__main__":
+    main()

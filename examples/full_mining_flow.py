@@ -1,0 +1,12 @@
+"""The whole §3.2 money path with a real (tiny) SD-1.5 model through the
+node: event -> filter -> hydrate -> batched solve -> commit -> reveal ->
+claim. Same as `python -m arbius_tpu.cli demo-mine`."""
+from arbius_tpu.cli import main as cli_main
+
+
+def main():
+    return cli_main(["demo-mine", "--prompt", "example mining flow"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Governance flow: delegate -> propose -> vote -> queue -> execute."""
+from arbius_tpu.chain import Governor, WAD
+from arbius_tpu.chain.governance import (TIMELOCK_MIN_DELAY, VOTING_DELAY,
+                                         VOTING_PERIOD)
+from examples._world import DEPLOYER, USER, deploy_model, make_world
+
+
+def main():
+    engine, token = make_world()
+    gov = Governor(engine)
+    # quorum is 4% of TOTAL supply (which includes the engine's 600k
+    # emission pool), so the voters need real weight
+    token.mint(DEPLOYER, 20_000 * WAD)
+    token.mint(USER, 20_000 * WAD)
+    token.delegate(DEPLOYER, DEPLOYER)
+    token.delegate(USER, USER)
+    engine.advance_time(1, 1)
+    mid = deploy_model(engine)
+    pid = gov.propose(DEPLOYER,
+                      [lambda: engine.set_solution_mineable_rate(mid, WAD)],
+                      "make the example model mineable at rate 1.0")
+    engine.advance_time(0, VOTING_DELAY + 1)
+    gov.cast_vote(DEPLOYER, pid, 1)
+    gov.cast_vote(USER, pid, 1)
+    engine.advance_time(0, VOTING_PERIOD)
+    gov.queue(pid)
+    engine.advance_time(TIMELOCK_MIN_DELAY + 1)
+    gov.execute(pid)
+    print(f"proposal executed; model rate now {engine.models[mid].rate / WAD}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""The five lookup examples (GetTask/GetSolution/GetContestation/...)."""
+from examples._world import USER, VALIDATOR, deploy_model, make_world, solve_task
+
+
+def main():
+    engine, _ = make_world(staked=(VALIDATOR,))
+    mid = deploy_model(engine)
+    tid = engine.submit_task(USER, 0, USER, mid, 0, b"{}")
+    solve_task(engine, tid)
+    print("model:", engine.models[mid])
+    print("task:", engine.tasks[tid])
+    print("solution:", engine.solutions[tid])
+    print("contestation:", engine.contestations.get(tid))
+    print("validator:", engine.validators[VALIDATOR])
+
+
+if __name__ == "__main__":
+    main()

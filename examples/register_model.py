@@ -1,0 +1,14 @@
+"""RegisterModel.sol parity: register a model, show its derived id."""
+from examples._world import DEPLOYER, MODEL_FEE_ADDR, TEMPLATE, make_world
+
+
+def main():
+    engine, _ = make_world()
+    mid = engine.register_model(DEPLOYER, MODEL_FEE_ADDR, 0, TEMPLATE)
+    print(f"model id: 0x{mid.hex()}")
+    print(f"template cid: 0x{engine.models[mid].cid.hex()}")
+    return mid
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""RetractTask.sol parity: owner reclaims fee (minus 10%) after the wait."""
+from arbius_tpu.chain import WAD
+from examples._world import USER, deploy_model, make_world
+
+
+def main():
+    engine, token = make_world()
+    mid = deploy_model(engine)
+    tid = engine.submit_task(USER, 0, USER, mid, 10 * WAD, b"{}")
+    engine.advance_time(10_001)
+    before = token.balance_of(USER)
+    engine.retract_task(USER, tid)
+    print(f"refunded: {(token.balance_of(USER) - before) / WAD} AIUS "
+          f"(fee 10, retraction fee 10%)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""SubmitContestation.sol parity: contest within the claim window."""
+from examples._world import (USER, VALIDATOR, VALIDATOR2, deploy_model,
+                             make_world, solve_task)
+
+
+def main():
+    engine, _ = make_world(engine_balance=597_000 * 10**18,
+                           staked=(VALIDATOR, VALIDATOR2))
+    mid = deploy_model(engine)
+    tid = engine.submit_task(USER, 0, USER, mid, 0, b"{}")
+    solve_task(engine, tid, VALIDATOR)
+    engine.submit_contestation(VALIDATOR2, tid)
+    con = engine.contestations[tid]
+    print(f"contested by {con.validator}; slash escrowed "
+          f"{con.slash_amount / 10**18} AIUS; auto-votes yea/nay recorded")
+
+
+if __name__ == "__main__":
+    main()

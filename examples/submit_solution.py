@@ -1,0 +1,14 @@
+"""SubmitSolution.sol parity: signal commitment, wait a block, reveal."""
+from examples._world import USER, VALIDATOR, deploy_model, make_world, solve_task
+
+
+def main():
+    engine, _ = make_world(staked=(VALIDATOR,))
+    mid = deploy_model(engine)
+    tid = engine.submit_task(USER, 0, USER, mid, 0, b"{}")
+    cid = solve_task(engine, tid)
+    print(f"solution cid 0x{cid.hex()} by {engine.solutions[tid].validator}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""SubmitTask.sol parity: submit a task, show the chained id + input CID."""
+from examples._world import USER, deploy_model, make_world
+
+
+def main():
+    engine, _ = make_world()
+    mid = deploy_model(engine)
+    tid = engine.submit_task(USER, 0, USER, mid, 0,
+                             b'{"prompt": "example", "negative_prompt": ""}')
+    task = engine.tasks[tid]
+    print(f"task id: 0x{tid.hex()} (prevhash now chains from it)")
+    print(f"input cid: 0x{task.cid.hex()}")
+    return tid
+
+
+if __name__ == "__main__":
+    main()

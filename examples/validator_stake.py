@@ -1,0 +1,16 @@
+"""Validator lifecycle: deposit, 2-step withdraw with unlock delay."""
+from arbius_tpu.chain import WAD
+from examples._world import VALIDATOR, make_world
+
+
+def main():
+    engine, token = make_world(staked=(VALIDATOR,))
+    count = engine.initiate_validator_withdraw(VALIDATOR, 40 * WAD)
+    engine.advance_time(86_400)
+    engine.validator_withdraw(VALIDATOR, count, VALIDATOR)
+    print(f"staked now: {engine.validators[VALIDATOR].staked / WAD} AIUS "
+          f"(withdrew 40 after the 1-day unlock)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""VoteOnContestation.sol parity: third validator votes within the period."""
+from arbius_tpu.chain import WAD
+from examples._world import (USER, VALIDATOR, VALIDATOR2, deploy_model,
+                             make_world, solve_task)
+
+VALIDATOR3 = "0x" + "13" * 20
+
+
+def main():
+    engine, token = make_world(engine_balance=597_000 * WAD,
+                               staked=(VALIDATOR, VALIDATOR2))
+    token.mint(VALIDATOR3, 1_000 * WAD)
+    token.approve(VALIDATOR3, engine.ADDRESS, 10**30)
+    engine.validator_deposit(VALIDATOR3, VALIDATOR3, 100 * WAD)
+    mid = deploy_model(engine)
+    tid = engine.submit_task(USER, 0, USER, mid, 0, b"{}")
+    solve_task(engine, tid, VALIDATOR)
+    engine.submit_contestation(VALIDATOR2, tid)
+    code = engine.validator_can_vote(VALIDATOR3, tid)
+    engine.vote_on_contestation(VALIDATOR3, tid, yea=True)
+    print(f"can-vote code was {code} (0 = allowed); "
+          f"yeas={len(engine.contestation_yeas[tid])} "
+          f"nays={len(engine.contestation_nays[tid])}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Every example module must run clean — they are the Example/*.sol parity
+surface and double as living documentation."""
+from __future__ import annotations
+
+import importlib
+
+import pytest
+
+EXAMPLES = [
+    "register_model", "submit_task", "retract_task", "submit_solution",
+    "claim_solution", "submit_contestation", "vote_on_contestation",
+    "finish_contestation", "lookups", "validator_stake",
+    "governance_proposal", "emission_curve",
+    # full_mining_flow is the demo-mine CLI path — exercised in its own
+    # (slow, jit-compiling) test below
+]
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name, capsys):
+    mod = importlib.import_module(f"examples.{name}")
+    mod.main()
+    assert capsys.readouterr().out.strip()
+
+
+def test_full_mining_flow_example(capsys):
+    mod = importlib.import_module("examples.full_mining_flow")
+    assert mod.main() == 0
+    out = capsys.readouterr().out
+    assert "claimed: True" in out
