@@ -29,6 +29,7 @@ class TokenLedger:
         self._vote_ckpts: dict[str, list[tuple[int, int]]] = {}
         self._supply_ckpts: list[tuple[int, int]] = []
         self.total_supply = 0
+        self.gateway: str | None = None   # L2 gateway, set at deployment
 
     # -- ERC20 -----------------------------------------------------------
     def mint(self, to: str, amount: int) -> None:
@@ -59,6 +60,28 @@ class TokenLedger:
             raise ValueError("ERC20: insufficient allowance")
         self.allowances[(owner, spender)] = allowed - amount
         self.transfer(owner, to, amount)
+
+    # -- Arbitrum gateway (BaseTokenV1.sol:54-68) ------------------------
+    def bridge_mint(self, sender: str, account: str, amount: int) -> None:
+        """Only the registered L2 gateway mints bridged deposits, capped
+        at MAX_SUPPLY (the L1 escrow guarantees the global invariant)."""
+        if sender != self.gateway:
+            raise ValueError("NOT_GATEWAY")
+        if self.total_supply + amount > MAX_SUPPLY:
+            raise ValueError("mint exceeds max supply")
+        self.mint(account, amount)
+
+    def bridge_burn(self, sender: str, account: str, amount: int) -> None:
+        """Gateway burns on withdrawal back to L1."""
+        if sender != self.gateway:
+            raise ValueError("NOT_GATEWAY")
+        bal = self.balances.get(account, 0)
+        if bal < amount:
+            raise ValueError("ERC20: burn amount exceeds balance")
+        self.balances[account] = bal - amount
+        self.total_supply -= amount
+        self._push(self._supply_ckpts, self.total_supply)
+        self._move_votes(self.delegates.get(account), None, amount)
 
     # -- votes (ERC20Votes subset) ---------------------------------------
     def delegate(self, owner: str, delegatee: str) -> None:

@@ -95,14 +95,18 @@ class Attention(nn.Module):
     num_heads: int
     head_dim: int
     dtype: jnp.dtype = jnp.bfloat16
+    qkv_bias: bool = False  # SD UNet attention: no bias; VAE attention: bias
 
     @nn.compact
     def __call__(self, x, context=None, mask=None):
         ctx = x if context is None else context
         inner = self.num_heads * self.head_dim
-        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
-        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
-        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
+        q = nn.Dense(inner, use_bias=self.qkv_bias, dtype=self.dtype,
+                     name="to_q")(x)
+        k = nn.Dense(inner, use_bias=self.qkv_bias, dtype=self.dtype,
+                     name="to_k")(ctx)
+        v = nn.Dense(inner, use_bias=self.qkv_bias, dtype=self.dtype,
+                     name="to_v")(ctx)
 
         def split(t):  # [B, S, inner] -> [B, H, S, D]
             b, s, _ = t.shape

@@ -233,6 +233,155 @@ def _convert_tree(template: dict, state_dict: dict, key_for) -> dict:
     return out
 
 
+# -- VAE decoder key translation -------------------------------------------
+
+_VAE_RESNET = {
+    "GroupNorm32_0/GroupNorm_0/scale": ("norm1.weight", _ident),
+    "GroupNorm32_0/GroupNorm_0/bias": ("norm1.bias", _ident),
+    "Conv_0/kernel": ("conv1.weight", _conv),
+    "Conv_0/bias": ("conv1.bias", _ident),
+    "GroupNorm32_1/GroupNorm_0/scale": ("norm2.weight", _ident),
+    "GroupNorm32_1/GroupNorm_0/bias": ("norm2.bias", _ident),
+    "Conv_1/kernel": ("conv2.weight", _conv),
+    "Conv_1/bias": ("conv2.bias", _ident),
+    "skip_proj/kernel": ("conv_shortcut.weight", _conv),
+    "skip_proj/bias": ("conv_shortcut.bias", _ident),
+}
+
+_VAE_ATTN = {
+    "GroupNorm32_0/GroupNorm_0/scale": ("group_norm.weight", _ident),
+    "GroupNorm32_0/GroupNorm_0/bias": ("group_norm.bias", _ident),
+    "Attention_0/to_q/kernel": ("to_q.weight", _linear),
+    "Attention_0/to_q/bias": ("to_q.bias", _ident),
+    "Attention_0/to_k/kernel": ("to_k.weight", _linear),
+    "Attention_0/to_k/bias": ("to_k.bias", _ident),
+    "Attention_0/to_v/kernel": ("to_v.weight", _linear),
+    "Attention_0/to_v/bias": ("to_v.bias", _ident),
+    "Attention_0/to_out/kernel": ("to_out.0.weight", _linear),
+    "Attention_0/to_out/bias": ("to_out.0.bias", _ident),
+}
+
+
+def vae_key_for(path: str, n_levels: int = 4):
+    """our VAEDecoder path -> (diffusers AutoencoderKL key, transform)."""
+    simple = {
+        "post_quant/kernel": ("post_quant_conv.weight", _conv),
+        "post_quant/bias": ("post_quant_conv.bias", _ident),
+        "conv_in/kernel": ("decoder.conv_in.weight", _conv),
+        "conv_in/bias": ("decoder.conv_in.bias", _ident),
+        "conv_out/kernel": ("decoder.conv_out.weight", _conv),
+        "conv_out/bias": ("decoder.conv_out.bias", _ident),
+        "norm_out/GroupNorm_0/scale": ("decoder.conv_norm_out.weight", _ident),
+        "norm_out/GroupNorm_0/bias": ("decoder.conv_norm_out.bias", _ident),
+    }
+    if path in simple:
+        return simple[path]
+    part, _, rest = path.partition("/")
+    m = re.match(r"mid_res_(\d)$", part)
+    if m:
+        leaf = _VAE_RESNET.get(rest)
+        if leaf:
+            return (f"decoder.mid_block.resnets.{m.group(1)}.{leaf[0]}",
+                    leaf[1])
+    if part == "mid_attn":
+        leaf = _VAE_ATTN.get(rest)
+        if leaf:
+            return f"decoder.mid_block.attentions.0.{leaf[0]}", leaf[1]
+    m = re.match(r"up_(\d+)_res_(\d+)$", part)
+    if m:
+        leaf = _VAE_RESNET.get(rest)
+        if leaf:
+            return (f"decoder.up_blocks.{n_levels - 1 - int(m.group(1))}"
+                    f".resnets.{m.group(2)}.{leaf[0]}", leaf[1])
+    m = re.match(r"up_(\d+)_us$", part)
+    if m:
+        if rest == "Conv_0/kernel":
+            return (f"decoder.up_blocks.{n_levels - 1 - int(m.group(1))}"
+                    ".upsamplers.0.conv.weight", _conv)
+        if rest == "Conv_0/bias":
+            return (f"decoder.up_blocks.{n_levels - 1 - int(m.group(1))}"
+                    ".upsamplers.0.conv.bias", _ident)
+    raise ConversionError(f"unmapped vae path {path!r}")
+
+
+# -- CLIP text encoder key translation -------------------------------------
+
+def _make_attn_head_tf(heads: int, head_dim: int, kind: str):
+    """CLIP [E, E]/[E] projections -> flax SelfAttention head layout."""
+    if kind == "qkv_kernel":
+        return lambda w: _linear(w).reshape(-1, heads, head_dim)
+    if kind == "qkv_bias":
+        return lambda b: np.asarray(b).reshape(heads, head_dim)
+    if kind == "out_kernel":
+        return lambda w: _linear(w).reshape(heads, head_dim, -1)
+    return _ident  # out bias
+
+
+def text_key_for(path: str, heads: int, head_dim: int):
+    """our TextEncoder path -> (transformers CLIPTextModel key, transform).
+
+    Production note: real CLIP checkpoints pair with the CLIP BPE
+    tokenizer; the TextEncoder consumes any id stream, so swap the
+    ByteTokenizer for a BPE tokenizer when loading converted weights.
+    """
+    simple = {
+        "token_embed/embedding":
+            ("text_model.embeddings.token_embedding.weight", _ident),
+        "pos_embed":
+            ("text_model.embeddings.position_embedding.weight", _ident),
+        "final_norm/scale": ("text_model.final_layer_norm.weight", _ident),
+        "final_norm/bias": ("text_model.final_layer_norm.bias", _ident),
+    }
+    if path in simple:
+        return simple[path]
+    m = re.match(r"layer_(\d+)/(.+)$", path)
+    if not m:
+        raise ConversionError(f"unmapped text path {path!r}")
+    base = f"text_model.encoder.layers.{m.group(1)}"
+    rest = m.group(2)
+    attn_names = {"query": "q_proj", "key": "k_proj", "value": "v_proj"}
+    for ours, theirs in attn_names.items():
+        if rest == f"attn/{ours}/kernel":
+            return (f"{base}.self_attn.{theirs}.weight",
+                    _make_attn_head_tf(heads, head_dim, "qkv_kernel"))
+        if rest == f"attn/{ours}/bias":
+            return (f"{base}.self_attn.{theirs}.bias",
+                    _make_attn_head_tf(heads, head_dim, "qkv_bias"))
+    if rest == "attn/out/kernel":
+        return (f"{base}.self_attn.out_proj.weight",
+                _make_attn_head_tf(heads, head_dim, "out_kernel"))
+    if rest == "attn/out/bias":
+        return f"{base}.self_attn.out_proj.bias", _ident
+    mlp = {
+        "Dense_0/kernel": ("mlp.fc1.weight", _linear),
+        "Dense_0/bias": ("mlp.fc1.bias", _ident),
+        "Dense_1/kernel": ("mlp.fc2.weight", _linear),
+        "Dense_1/bias": ("mlp.fc2.bias", _ident),
+        "LayerNorm_0/scale": ("layer_norm1.weight", _ident),
+        "LayerNorm_0/bias": ("layer_norm1.bias", _ident),
+        "LayerNorm_1/scale": ("layer_norm2.weight", _ident),
+        "LayerNorm_1/bias": ("layer_norm2.bias", _ident),
+    }
+    if rest in mlp:
+        key, tf = mlp[rest]
+        return f"{base}.{key}", tf
+    raise ConversionError(f"unmapped text path {path!r}")
+
+
+def convert_sd15_vae(state_dict: dict, template_params: dict,
+                     n_levels: int = 4) -> dict:
+    """diffusers AutoencoderKL state dict → our VAEDecoder param tree."""
+    return _convert_tree(template_params, state_dict,
+                         lambda p: vae_key_for(p, n_levels))
+
+
+def convert_sd15_text(state_dict: dict, template_params: dict,
+                      heads: int, head_dim: int) -> dict:
+    """transformers CLIPTextModel state dict → our TextEncoder tree."""
+    return _convert_tree(template_params, state_dict,
+                         lambda p: text_key_for(p, heads, head_dim))
+
+
 def convert_sd15_unet(state_dict: dict, template_params: dict,
                       n_levels: int = 4) -> dict:
     """diffusers UNet2DConditionModel state dict → our unet param tree.

@@ -49,7 +49,9 @@ class _MidAttention(nn.Module):
         residual = x
         x = GroupNorm32()(x)
         x = x.reshape(b, h * w, c)
-        x = Attention(num_heads=1, head_dim=c, dtype=self.dtype)(x)
+        # qkv_bias=True: the published VAE checkpoints carry q/k/v biases
+        x = Attention(num_heads=1, head_dim=c, dtype=self.dtype,
+                      qkv_bias=True)(x)
         return residual + x.reshape(b, h, w, c)
 
 

@@ -59,6 +59,8 @@ class MiningConfig:
     # dp batch per solve dispatch; MUST be fleet-wide per model class
     # (batch size is part of the XLA program = the determinism class)
     canonical_batch: int = 1
+    profile_dir: str | None = None   # jax.profiler trace output dir
+    profile_every: int = 0           # trace every Nth solve dispatch
 
 
 _KNOWN = {f for f in MiningConfig.__dataclass_fields__}

@@ -196,6 +196,33 @@ class NodeDB:
                 (taskid, validator, str(blocktime)))
             self._conn.commit()
 
+    def prune_before(self, cutoff: int) -> int:
+        """GC: drop ALL rows of claimed tasks older than `cutoff` (the
+        reference's pinata_unpin_old_files.ts equivalent — bounded local
+        state instead of unbounded pin storage). Returns tasks removed."""
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM tasks WHERE CAST(blocktime AS INTEGER) < ? "
+                "AND id IN (SELECT taskid FROM solutions WHERE claimed = 1)",
+                (cutoff,))
+            for table in ("task_inputs", "solutions", "contestations",
+                          "contestation_votes", "invalid_tasks"):
+                self._conn.execute(
+                    f"DELETE FROM {table} WHERE taskid NOT IN "
+                    "(SELECT id FROM tasks)")
+            self._conn.commit()
+            return cur.rowcount
+
+    def recent_tasks(self, limit: int = 50) -> list[sqlite3.Row]:
+        """Task + solution join for the explorer, newest first."""
+        with self._lock:
+            return self._conn.execute(
+                "SELECT t.id, t.modelid, t.fee, t.address, t.blocktime, "
+                "s.validator, s.cid, s.claimed, "
+                "(SELECT 1 FROM invalid_tasks i WHERE i.taskid = t.id) inv "
+                "FROM tasks t LEFT JOIN solutions s ON s.taskid = t.id "
+                "ORDER BY t.rowid DESC LIMIT ?", (limit,)).fetchall()
+
     def store_vote(self, taskid: str, validator: str, yea: bool) -> None:
         with self._lock:
             self._conn.execute(

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import json
 import logging
+import time
+from collections import deque
 from dataclasses import dataclass, field
 
 from arbius_tpu.l0.commitment import taskid2seed
@@ -52,7 +54,14 @@ class NodeMetrics:
     votes_cast: int = 0
     tasks_seen: int = 0
     tasks_invalid: int = 0
-    solve_latency: list = field(default_factory=list)  # (taskid, seconds)
+    # rolling windows (deque maxlen): percentiles reflect RECENT behavior
+    # and memory stays bounded on long-running miners
+    solve_latency: deque = field(
+        default_factory=lambda: deque(maxlen=1000))  # (taskid, chain s)
+    # wall-clock stage spans per solve dispatch (SURVEY.md §5 tracing):
+    # infer = model + encode + CID; commit = chain txs for the bucket
+    stage_seconds: dict = field(default_factory=lambda: {
+        "infer": deque(maxlen=1000), "commit": deque(maxlen=1000)})
 
 
 class BootError(RuntimeError):
@@ -111,6 +120,10 @@ class MinerNode:
             self._on_solution_submitted(ev.args)
         elif name == "ContestationSubmitted":
             self._on_contestation(ev.args)
+        elif name == "SolutionClaimed":
+            # engine flips claimed before emitting, so the generic sync
+            # stores claimed=True
+            self._sync_solution("0x" + ev.args["task"].hex())
         elif name == "ContestationVote":
             self.db.store_vote("0x" + ev.args["task"].hex(),
                                ev.args["addr"], ev.args["yea"])
@@ -129,12 +142,15 @@ class MinerNode:
                            self.chain.now, 0, "")
         self.db.queue_job("task", {"taskid": taskid}, concurrent=True)
 
-    def _on_solution_submitted(self, args: dict) -> None:
-        taskid = "0x" + args["task"].hex()
+    def _sync_solution(self, taskid: str) -> None:
         sol = self.chain.get_solution(taskid)
         if sol is not None:
             self.db.store_solution(taskid, sol.validator, sol.blocktime,
                                    sol.claimed, "0x" + sol.cid.hex())
+
+    def _on_solution_submitted(self, args: dict) -> None:
+        taskid = "0x" + args["task"].hex()
+        self._sync_solution(taskid)
         # solution for a task we proved invalid → contest (index.ts:236-266)
         if args["addr"] != self.chain.address and \
                 self.db.is_invalid_task(taskid):
@@ -266,16 +282,21 @@ class MinerNode:
         for (model_id, *_), entries in by_bucket.items():
             m = self.registry.get(model_id)
             t_start = self.chain.now
+            w_start = time.perf_counter()
             try:
-                results = solve_cid_batch(
-                    m, [(h, h["seed"]) for _, h in entries],
-                    evilmode=self.config.evilmode,
-                    canonical_batch=self.config.canonical_batch)
+                with self._maybe_profile():
+                    results = solve_cid_batch(
+                        m, [(h, h["seed"]) for _, h in entries],
+                        evilmode=self.config.evilmode,
+                        canonical_batch=self.config.canonical_batch)
             except Exception as e:  # noqa: BLE001 — whole bucket failed
                 log.warning("bucket solve failed: %r", e)
                 for job, _ in entries:
                     self.db.fail_job(job)
                 continue
+            self.metrics.stage_seconds["infer"].append(
+                time.perf_counter() - w_start)
+            w_commit = time.perf_counter()
             for (job, _), (cid, _files) in zip(entries, results):
                 try:
                     self._commit_reveal(job.data["taskid"], cid, t_start)
@@ -284,7 +305,25 @@ class MinerNode:
                 except Exception as e:  # noqa: BLE001
                     log.warning("solve commit failed: %r", e)
                     self.db.fail_job(job)
+            self.metrics.stage_seconds["commit"].append(
+                time.perf_counter() - w_commit)
         return done
+
+    def _maybe_profile(self):
+        """jax.profiler trace around every Nth solve dispatch when the
+        operator sets profile_dir (SURVEY.md §5: the reference has no
+        miner-side tracing at all)."""
+        import contextlib
+
+        cfg = self.config
+        if not cfg.profile_dir or cfg.profile_every <= 0:
+            return contextlib.nullcontext()
+        self._profile_counter = getattr(self, "_profile_counter", 0) + 1
+        if self._profile_counter % cfg.profile_every:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.profiler.trace(cfg.profile_dir)
 
     def _commit_reveal(self, taskid: str, cid: str, t_start: int) -> None:
         """index.ts:566-672: skip if solved (contest on CID mismatch —

@@ -15,6 +15,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 
+def _p50(values):
+    return float(np.median(values)) if values else None
+
+
 class ControlRPC:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
         self.node = node
@@ -33,7 +37,17 @@ class ControlRPC:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/api/jobs/get":
+                if self.path == "/" or self.path == "/explorer":
+                    body = outer.explorer_html().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/api/tasks":
+                    self._send(200, outer.recent_tasks())
+                elif self.path == "/api/jobs/get":
                     jobs = outer.node.db.get_jobs(now=2**62)
                     self._send(200, [{
                         "id": j.id, "method": j.method, "priority": j.priority,
@@ -76,6 +90,44 @@ class ControlRPC:
         self.port = self.server.server_address[1]
         self._thread: threading.Thread | None = None
 
+    def recent_tasks(self, limit: int = 50) -> list[dict]:
+        """Task/solution view — the explorer's data source (the reference
+        website's explorer + task/[taskid] pages, `website/src/pages`)."""
+        rows = self.node.db.recent_tasks(limit)
+        return [{
+            "taskid": r["id"], "model": r["modelid"], "fee": r["fee"],
+            "owner": r["address"], "blocktime": r["blocktime"],
+            "solution_validator": r["validator"], "solution_cid": r["cid"],
+            "claimed": bool(r["claimed"]) if r["claimed"] is not None else None,
+            "invalid": bool(r["inv"]),
+        } for r in rows]
+
+    def explorer_html(self) -> str:
+        """Single-page explorer (L5 parity: the reference ships a Next.js
+        dapp; the node serves an equivalent local view of tasks,
+        solutions, and miner health with zero build tooling)."""
+        m = self.metrics()
+        rows = "".join(
+            f"<tr><td><code>{t['taskid'][:18]}…</code></td>"
+            f"<td><code>{(t['model'] or '')[:14]}…</code></td>"
+            f"<td>{t['fee']}</td>"
+            f"<td>{'invalid' if t['invalid'] else ('claimed' if t['claimed'] else ('solved' if t['solution_validator'] else 'pending'))}</td>"
+            f"<td><code>{(t['solution_cid'] or '')[:20]}</code></td></tr>"
+            for t in self.recent_tasks())
+        stats = "".join(f"<li>{k}: <b>{v}</b></li>" for k, v in m.items())
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            "<title>arbius-tpu node</title><style>"
+            "body{font-family:system-ui;margin:2rem;max-width:70rem}"
+            "table{border-collapse:collapse;width:100%}"
+            "td,th{border:1px solid #ccc;padding:.3rem .5rem;text-align:left}"
+            "code{font-size:.85em}</style></head><body>"
+            f"<h1>arbius-tpu node <small>{self.node.chain.address}</small></h1>"
+            f"<h2>Metrics</h2><ul>{stats}</ul>"
+            "<h2>Recent tasks</h2><table><tr><th>task</th><th>model</th>"
+            f"<th>fee</th><th>status</th><th>solution cid</th></tr>{rows}"
+            "</table></body></html>")
+
     def metrics(self) -> dict:
         m = self.node.metrics
         lat = [s for _, s in m.solve_latency]
@@ -87,8 +139,10 @@ class ControlRPC:
             "contestations_submitted": m.contestations_submitted,
             "votes_cast": m.votes_cast,
             "queue_depth": self.node.db.job_count(),
-            "solve_latency_p50": float(np.median(lat)) if lat else None,
+            "solve_latency_p50": _p50(lat),
             "solve_latency_p95": float(np.percentile(lat, 95)) if lat else None,
+            "stage_infer_p50_s": _p50(m.stage_seconds.get("infer", [])),
+            "stage_commit_p50_s": _p50(m.stage_seconds.get("commit", [])),
         }
 
     def start(self) -> None:

@@ -84,6 +84,76 @@ def test_shape_mismatch_fails_loudly(unet_params):
         convert_sd15_unet(sd, unet_params)
 
 
+def _synthesize(template, key_for):
+    """Build a diffusers-style state dict whose conversion reproduces the
+    template tree exactly (per-leaf inverse of the declared transform)."""
+    sd = {}
+
+    def visit(path, leaf):
+        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path)
+        key, tf = key_for(p)
+        w = np.asarray(leaf)
+        name = getattr(tf, "__name__", "")
+        if name == "_conv":
+            sd[key] = np.transpose(w, (3, 2, 0, 1))
+        elif name == "_linear":
+            sd[key] = np.transpose(w)
+        elif name == "_ident":
+            sd[key] = w
+        else:  # head-layout lambdas: invert reshape/transpose
+            if w.ndim == 3 and key.endswith("out_proj.weight"):
+                sd[key] = np.transpose(w.reshape(-1, w.shape[-1]))
+            elif w.ndim == 3:   # (in, heads, head_dim) qkv kernel
+                sd[key] = np.transpose(w.reshape(w.shape[0], -1))
+            elif w.ndim == 2 and "bias" in key:   # (heads, head_dim)
+                sd[key] = w.reshape(-1)
+            else:
+                raise AssertionError(f"unexpected leaf for {key}")
+
+    jax.tree_util.tree_map_with_path(visit, template)
+    return sd
+
+
+def test_vae_conversion_roundtrip():
+    from arbius_tpu.models.sd15.convert import convert_sd15_vae, vae_key_for
+
+    pipe = SD15Pipeline(SD15Config.tiny(),
+                        tokenizer=ByteTokenizer(max_length=16, bos_id=257,
+                                                eos_id=258))
+    vae_params = pipe.init_params(seed=1)["vae"]
+    sd = _synthesize(vae_params, lambda p: vae_key_for(p, 4))
+    assert "decoder.mid_block.attentions.0.to_q.weight" in sd
+    assert "post_quant_conv.weight" in sd
+    back = convert_sd15_vae(sd, vae_params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        vae_params, back)
+
+
+def test_text_conversion_roundtrip():
+    from arbius_tpu.models.sd15.convert import (
+        convert_sd15_text,
+        text_key_for,
+    )
+
+    cfg = SD15Config.tiny()
+    pipe = SD15Pipeline(cfg, tokenizer=ByteTokenizer(max_length=16,
+                                                     bos_id=257, eos_id=258))
+    text_params = pipe.init_params(seed=2)["text"]
+    heads = cfg.text.heads
+    head_dim = cfg.text.width // heads
+    sd = _synthesize(text_params, lambda p: text_key_for(p, heads, head_dim))
+    assert "text_model.encoder.layers.0.self_attn.q_proj.weight" in sd
+    assert "text_model.embeddings.token_embedding.weight" in sd
+    back = convert_sd15_text(sd, text_params, heads, head_dim)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        text_params, back)
+
+
 def test_geglu_split_order_matches_diffusers(unet_params):
     """diffusers GEGLU chunks proj output as (value, gate) — our ff_val
     must take the FIRST half."""

@@ -328,3 +328,19 @@ def test_claim_latency_metrics_recorded():
     submit(eng, mid)
     drain(node)
     assert len(node.metrics.solve_latency) == 1
+    assert len(node.metrics.stage_seconds["infer"]) == 1
+    assert len(node.metrics.stage_seconds["commit"]) == 1
+
+
+def test_db_prune_keeps_unclaimed():
+    eng, tok, chain, node, mid = build_world()
+    t_old = submit(eng, mid, prompt="old")
+    drain(node)
+    eng.advance_time(2200)
+    drain(node)  # claimed
+    t_new = submit(eng, mid, prompt="new")
+    drain(node)  # solved but NOT claimed yet
+    removed = node.db.prune_before(eng.now + 10**6)
+    assert removed == 1
+    assert node.db.get_task(t_old) is None
+    assert node.db.get_task(t_new) is not None

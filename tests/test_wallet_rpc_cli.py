@@ -108,6 +108,40 @@ def test_rpc_metrics(rpc_node):
     assert "queue_depth" in m and "solve_latency_p50" in m
 
 
+def test_rpc_explorer_and_tasks(rpc_node):
+    node, rpc = rpc_node
+    node.db.store_task("0x" + "ab" * 32, "0x" + "cd" * 32, 5, "0x" + "01" * 20,
+                       100, 0, "")
+    node.db.store_solution("0x" + "ab" * 32, "0x" + "aa" * 20, 200, False,
+                           "0x1220" + "ee" * 32)
+    tasks = _get(rpc.port, "/api/tasks")
+    assert tasks[0]["taskid"] == "0x" + "ab" * 32
+    assert tasks[0]["solution_cid"] == "0x1220" + "ee" * 32
+    with urllib.request.urlopen(f"http://127.0.0.1:{rpc.port}/") as r:
+        html = r.read().decode()
+    assert "arbius-tpu node" in html and "Recent tasks" in html
+
+
+def test_bridge_token_gateway():
+    from arbius_tpu.chain import TokenLedger
+
+    tok = TokenLedger()
+    gw = "0x" + "99" * 20
+    tok.gateway = gw
+    user = "0x" + "01" * 20
+    tok.bridge_mint(gw, user, 100)
+    assert tok.balance_of(user) == 100 and tok.total_supply == 100
+    with pytest.raises(ValueError, match="NOT_GATEWAY"):
+        tok.bridge_mint(user, user, 1)
+    tok.bridge_burn(gw, user, 40)
+    assert tok.balance_of(user) == 60 and tok.total_supply == 60
+    with pytest.raises(ValueError, match="NOT_GATEWAY"):
+        tok.bridge_burn(user, user, 1)
+    from arbius_tpu.chain.token import MAX_SUPPLY
+    with pytest.raises(ValueError, match="max supply"):
+        tok.bridge_mint(gw, user, MAX_SUPPLY)
+
+
 def test_rpc_bad_requests(rpc_node):
     _, rpc = rpc_node
     with pytest.raises(urllib.error.HTTPError) as e:
