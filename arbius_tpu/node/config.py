@@ -61,6 +61,7 @@ class MiningConfig:
     canonical_batch: int = 1
     profile_dir: str | None = None   # jax.profiler trace output dir
     profile_every: int = 0           # trace every Nth solve dispatch
+    compile_cache_dir: str | None = ".jax_cache"  # persistent XLA cache
 
 
 _KNOWN = {f for f in MiningConfig.__dataclass_fields__}

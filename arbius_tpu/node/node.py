@@ -80,6 +80,10 @@ class MinerNode:
 
     # -- boot (start.ts:11-52 + index.ts:971-1020) -----------------------
     def boot(self, *, skip_self_test: bool = False) -> None:
+        if self.config.compile_cache_dir:
+            from arbius_tpu.utils import enable_compile_cache
+
+            enable_compile_cache(self.config.compile_cache_dir)
         self.db.clear_jobs_by_method("validatorStake")
         self.db.clear_jobs_by_method("automine")
         if self.chain.version() > MINER_VERSION:

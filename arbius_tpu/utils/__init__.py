@@ -1,0 +1,8 @@
+"""Shared utilities: parameter checkpointing, compile-cache setup."""
+from arbius_tpu.utils.checkpoint import (
+    enable_compile_cache,
+    load_params,
+    save_params,
+)
+
+__all__ = ["enable_compile_cache", "load_params", "save_params"]

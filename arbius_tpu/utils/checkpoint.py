@@ -1,0 +1,44 @@
+"""Checkpoint/resume support (SURVEY.md §5).
+
+The reference's checkpoint is its sqlite DB; model weights live inside
+cog containers and reload with them. Here weights are first-class:
+
+  - `save_params` / `load_params`: param-tree persistence via orbax
+    (the converted checkpoint is written once at deployment; the node
+    restores it at boot — no re-conversion, no container pulls)
+  - `enable_compile_cache`: persistent XLA compilation cache, so a node
+    restart (or the bench) skips the multi-minute jit of each shape
+    bucket — the "compiled-graph cache keyed by (model, shape bucket)"
+    the survey calls for, with the key handled by XLA's own fingerprint
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def enable_compile_cache(cache_dir: str) -> None:
+    """Idempotent; safe before or after backend init."""
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def save_params(path: str, params: dict) -> None:
+    """Write a param tree with orbax (atomic directory checkpoint)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+
+
+def load_params(path: str) -> dict:
+    """Restore a param tree saved by save_params."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(path)
