@@ -1,8 +1,9 @@
-"""Shared utilities: parameter checkpointing, compile-cache setup."""
+"""Shared utilities: parameter checkpointing, compile-cache setup, platform forcing."""
 from arbius_tpu.utils.checkpoint import (
     enable_compile_cache,
     load_params,
     save_params,
 )
+from arbius_tpu.utils.platform import force_cpu_devices
 
-__all__ = ["enable_compile_cache", "load_params", "save_params"]
+__all__ = ["enable_compile_cache", "force_cpu_devices", "load_params", "save_params"]

@@ -1,0 +1,59 @@
+"""Host-platform forcing for CPU-mesh simulation.
+
+The deployment environment pins ``JAX_PLATFORMS=axon`` (a remote-TPU
+tunnel serving one chip, registered by sitecustomize in every
+interpreter) and that tunnel can hang for minutes when unhealthy. Test
+runs and multi-chip dry-runs (SURVEY.md §4: "multi-chip behavior tested
+with jax CPU mesh simulation") must therefore force the host platform
+*and* neuter non-CPU backend factories so backend discovery never dials
+the tunnel. Shared by tests/conftest.py and __graft_entry__.py so the
+private-API workaround lives in exactly one place.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_devices(n_devices: int, spare: tuple[str, ...] = ("cpu", "tpu")) -> None:
+    """Force the CPU platform with `n_devices` virtual devices.
+
+    Must run before any jax backend is initialized: XLA_FLAGS is parsed
+    once per process, so a late call is unrecoverable — it raises
+    RuntimeError (before mutating any global state) rather than leaving
+    the caller with a silently wrong device count.
+    """
+    import jax
+
+    try:
+        import jax._src.xla_bridge as _xb
+    except Exception:  # pragma: no cover - jax internals moved
+        _xb = None
+    if _xb is not None and getattr(_xb, "_backends", None):
+        raise RuntimeError(
+            "jax backend already initialized in this process; "
+            "force_cpu_devices must run in a fresh interpreter")
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--?xla_force_host_platform_device_count=\d+", opt, flags)
+    else:
+        flags = (flags + " " + opt).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+    if _xb is None:
+        return
+    try:
+        _xb._discover_and_register_pjrt_plugins()
+    except Exception:
+        pass
+    try:
+        for _name in list(getattr(_xb, "_backend_factories", {})):
+            if _name not in spare:
+                _xb.register_backend_factory(
+                    _name, lambda: None, priority=-100, fail_quietly=True)
+    except Exception:
+        pass

@@ -13,25 +13,100 @@ cog miner on the same task shape, ~0.5 solutions/s end-to-end inference
 (init_params); FLOPs and memory traffic are identical to converted weights,
 so throughput is representative.
 
+Robustness (the round-1 bench timed out with zero output): a subprocess
+probe checks the remote-TPU tunnel first — backend init has been observed
+to hang >15 min when the tunnel is unhealthy. If the probe fails, the
+bench falls back to a reduced CPU-only config and STILL prints its JSON
+line, flagged `"note": "tpu_unreachable_cpu_fallback"` with
+`vs_baseline: 0` (no perf claim). Progress goes to stderr so a timeout
+still yields diagnostics. A persistent XLA compile cache under
+`.jax_cache_bench/` makes re-runs skip the multi-minute jit.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import numpy as np
 
 A100_SOLUTIONS_PER_HOUR = 1800.0  # documented anchor, see module docstring
 
 WIDTH = HEIGHT = 512
 STEPS = 20
 SCHEDULER = "DPMSolverMultistep"
+ROUNDS = 2
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "300"))
+
+_T0 = time.perf_counter()
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _note(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _tpu_reachable() -> tuple[bool, str]:
+    """Probe backend init in a subprocess so a tunnel hang can't eat the bench.
+
+    Returns (ok, reason) where reason distinguishes a deliberate CPU run
+    (`cpu_forced`) from a dead tunnel (`tpu_unreachable_cpu_fallback`).
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _note("JAX_PLATFORMS=cpu set — deliberate CPU run, skipping probe")
+        return False, "cpu_forced"
+    _note(f"probing TPU backend init (timeout {PROBE_TIMEOUT_S}s)")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d[0].platform, len(d))"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        _note("probe TIMED OUT — TPU tunnel unreachable")
+        return False, "tpu_unreachable_cpu_fallback"
+    out = (r.stdout or "").strip().splitlines()
+    ok = r.returncode == 0 and bool(out) and not out[-1].startswith("cpu")
+    _note(f"probe rc={r.returncode} out={out[-1] if out else ''!r} -> "
+          f"{'TPU ok' if ok else 'no TPU'}")
+    return ok, "ok" if ok else "tpu_unreachable_cpu_fallback"
+
+
+def _run(pipe, params, batch: int, *, width: int, height: int, steps: int,
+         rounds: int) -> tuple[float, object]:
+    kw = dict(width=width, height=height, num_inference_steps=steps,
+              scheduler=SCHEDULER, guidance_scale=12.0)
+    prompts = [f"arbius bench task {i}" for i in range(batch)]
+    negs = [""] * batch
+    _note(f"compiling + warmup: batch={batch} {width}x{height} steps={steps}")
+    pipe.generate(params, prompts, negs, list(range(batch)), **kw)
+    _note("warmup done; timing")
+    t0 = time.perf_counter()
+    out = None
+    for r in range(rounds):
+        out = pipe.generate(params, prompts, negs,
+                            [r * batch + i for i in range(batch)], **kw)
+        _note(f"round {r + 1}/{rounds} done")
+    return time.perf_counter() - t0, out
 
 
 def main() -> None:
+    on_tpu, reason = _tpu_reachable()
+    if not on_tpu:
+        # Never let in-process backend discovery dial the dead tunnel.
+        from arbius_tpu.utils import force_cpu_devices
+
+        force_cpu_devices(1)
+
+    import jax
+    import numpy as np
+
     from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
+    from arbius_tpu.utils import enable_compile_cache
+
+    enable_compile_cache(os.path.join(_REPO, ".jax_cache_bench"))
 
     n_dev = len(jax.devices())
     batch = max(1, n_dev)  # one task per chip — the dp unit of the miner
@@ -40,35 +115,44 @@ def main() -> None:
         from arbius_tpu.parallel import MeshSpec, build_mesh
 
         mesh = build_mesh(MeshSpec(dp=n_dev))
+    _note(f"platform={jax.devices()[0].platform} n_dev={n_dev}")
 
-    cfg = SD15Config()  # full production topology
-    pipe = SD15Pipeline(cfg, mesh=mesh, tokenizer=ByteTokenizer())
+    if on_tpu:
+        width, height, steps = WIDTH, HEIGHT, STEPS
+        cfg = SD15Config()  # full production topology
+    else:
+        # Documented reduced CPU fallback: full pipeline structure at tiny
+        # width so the line still prints on a 1-core host. No perf claim.
+        width, height, steps = 128, 128, 4
+        cfg = SD15Config.tiny()
+
+    tok = ByteTokenizer() if on_tpu else ByteTokenizer(
+        max_length=cfg.text.max_length, bos_id=257, eos_id=258)
+    pipe = SD15Pipeline(cfg, mesh=mesh, tokenizer=tok)
     params = pipe.place_params(pipe.init_params(seed=0,
-                                                height=HEIGHT, width=WIDTH))
+                                                height=height, width=width))
+    dt, out = _run(pipe, params, batch, width=width, height=height,
+                   steps=steps, rounds=ROUNDS)
+    assert out.shape == (batch, height, width, 3) and out.dtype == np.uint8
 
-    kw = dict(width=WIDTH, height=HEIGHT, num_inference_steps=STEPS,
-              scheduler=SCHEDULER, guidance_scale=12.0)
-    prompts = [f"arbius bench task {i}" for i in range(batch)]
-    negs = [""] * batch
-
-    # warmup: compile the bucket + one steady-state run
-    pipe.generate(params, prompts, negs, list(range(batch)), **kw)
-
-    rounds = 3
-    t0 = time.perf_counter()
-    for r in range(rounds):
-        out = pipe.generate(params, prompts, negs,
-                            [r * batch + i for i in range(batch)], **kw)
-    dt = time.perf_counter() - t0
-    assert out.shape == (batch, HEIGHT, WIDTH, 3) and out.dtype == np.uint8
-
-    per_chip = (rounds * batch / dt) * 3600.0 / n_dev
-    print(json.dumps({
-        "metric": "anythingv3_solutions_per_hour_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "solutions/hour/chip (SD-1.5 512x512, 20 steps, DPM++)",
-        "vs_baseline": round(per_chip / A100_SOLUTIONS_PER_HOUR, 3),
-    }))
+    per_chip = (ROUNDS * batch / dt) * 3600.0 / n_dev
+    if on_tpu:
+        line = {
+            "metric": "anythingv3_solutions_per_hour_per_chip",
+            "value": round(per_chip, 2),
+            "unit": "solutions/hour/chip (SD-1.5 512x512, 20 steps, DPM++)",
+            "vs_baseline": round(per_chip / A100_SOLUTIONS_PER_HOUR, 3),
+        }
+    else:
+        line = {
+            "metric": "anythingv3_solutions_per_hour_per_chip",
+            "value": round(per_chip, 2),
+            "unit": (f"solutions/hour/chip (CPU FALLBACK: tiny config "
+                     f"{width}x{height}, {steps} steps — no TPU perf claim)"),
+            "vs_baseline": 0.0,
+            "note": reason,
+        }
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

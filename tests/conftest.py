@@ -1,37 +1,17 @@
 """Test harness config.
 
 Multi-chip behavior is tested on a virtual 8-device CPU mesh (the driver
-separately dry-run-compiles the multichip path): force the host platform
-BEFORE jax is imported anywhere.
+separately dry-run-compiles the multichip path). The environment's
+sitecustomize registers the remote-TPU `axon` backend in every
+interpreter with JAX_PLATFORMS=axon already cached, so env vars alone
+are too late — `force_cpu_devices` forces the jax config and neuters
+non-CPU backend factories before any backend init.
 """
-import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-# The environment's sitecustomize imports jax at interpreter startup to
-# register the `axon` remote-TPU backend, so jax has ALREADY cached
-# JAX_PLATFORMS=axon from the outer environment by the time this conftest
-# runs — the os.environ assignment above is too late on its own. Force the
-# config directly, and neuter the axon factory so backend discovery can't
-# touch the (possibly unhealthy) TPU tunnel from a CPU-only test run.
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    import jax._src.xla_bridge as _xb
-
-    _xb._discover_and_register_pjrt_plugins()
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu", "tpu"):
-            _xb.register_backend_factory(
-                _name, lambda: None, priority=-100, fail_quietly=True)
-except Exception:
-    pass
-
 import pathlib
+
+from arbius_tpu.utils import force_cpu_devices
+
+force_cpu_devices(8)
 
 import pytest
 
