@@ -79,3 +79,87 @@ class Eip1559Tx:
 
     def tx_hash(self, wallet: Wallet) -> bytes:
         return keccak256(self.sign(wallet))
+
+
+def rlp_decode(data: bytes):
+    """Decode one RLP item; raises on trailing bytes (canonical payloads)."""
+    item, rest = _decode_item(memoryview(data))
+    if len(rest):
+        raise ValueError("trailing bytes after RLP item")
+    return item
+
+
+def _decode_item(mv):
+    if not len(mv):
+        raise ValueError("empty RLP input")
+    b0 = mv[0]
+    if b0 < 0x80:
+        return bytes(mv[:1]), mv[1:]
+    if b0 < 0xC0:
+        length, mv = _decode_length(mv, 0x80)
+        if length > len(mv):
+            raise ValueError("RLP string length exceeds input")
+        return bytes(mv[:length]), mv[length:]
+    length, mv = _decode_length(mv, 0xC0)
+    if length > len(mv):
+        raise ValueError("RLP list length exceeds input")
+    payload, rest = mv[:length], mv[length:]
+    items = []
+    while len(payload):
+        item, payload = _decode_item(payload)
+        items.append(item)
+    return items, rest
+
+
+def _decode_length(mv, offset: int):
+    b0 = mv[0]
+    if b0 <= offset + 55:
+        return b0 - offset, mv[1:]
+    n = b0 - offset - 55
+    if 1 + n > len(mv):
+        raise ValueError("RLP length prefix out of range")
+    length = int.from_bytes(bytes(mv[1:1 + n]), "big")
+    return length, mv[1 + n:]
+
+
+def _as_int(b: bytes) -> int:
+    return int.from_bytes(b, "big")
+
+
+@dataclass(frozen=True)
+class DecodedTx:
+    """A signed EIP-1559 transaction as recovered by a receiving node."""
+    tx: Eip1559Tx
+    sender: str
+    tx_hash: bytes
+    r: int
+    s: int
+    y_parity: int
+
+
+def decode_signed_eip1559(raw: bytes) -> DecodedTx:
+    """Parse + verify a raw 0x02 transaction: the receiving side of
+    `Eip1559Tx.sign`. Recovers the sender from the signature, so a fake
+    chain node (or test) can apply the state change the tx encodes —
+    closing the sign → RLP → decode → state-change loop the reference
+    only exercises against live Nova (`miner/test/utils.test.ts:60-69`).
+    """
+    from arbius_tpu.chain.wallet import recover_address
+
+    if not raw or raw[0] != 0x02:
+        raise ValueError("not an EIP-1559 (0x02) transaction")
+    fields = rlp_decode(raw[1:])
+    if not isinstance(fields, list) or len(fields) != 12:
+        raise ValueError("signed EIP-1559 payload must have 12 fields")
+    (chain_id, nonce, prio, max_fee, gas, to, value, data,
+     access_list, y, r, s) = fields
+    tx = Eip1559Tx(
+        chain_id=_as_int(chain_id), nonce=_as_int(nonce),
+        max_priority_fee_per_gas=_as_int(prio),
+        max_fee_per_gas=_as_int(max_fee), gas_limit=_as_int(gas),
+        to="0x" + to.hex() if to else None, value=_as_int(value),
+        data=data, access_list=tuple(access_list))
+    sender = recover_address(tx.signing_hash(), _as_int(r), _as_int(s),
+                             _as_int(y))
+    return DecodedTx(tx=tx, sender=sender, tx_hash=keccak256(raw),
+                     r=_as_int(r), s=_as_int(s), y_parity=_as_int(y))

@@ -106,13 +106,25 @@ class EngineRpcClient:
 
     # -- reads -----------------------------------------------------------
     def eth_call(self, signature: str, types: list[str], values: list) -> bytes:
+        return self.eth_call_to(self.engine_address, signature, types, values)
+
+    def eth_call_to(self, address: str, signature: str, types: list[str],
+                    values: list) -> bytes:
         data = call_data(signature, types, values)
         result = self.transport.request("eth_call", [{
-            "to": self.engine_address, "data": "0x" + data.hex()}, "latest"])
+            "to": address.lower(), "data": "0x" + data.hex()}, "latest"])
         return bytes.fromhex(result[2:])
 
     def block_number(self) -> int:
         return int(self.transport.request("eth_blockNumber", []), 16)
+
+    def block_timestamp(self) -> int:
+        blk = self.transport.request("eth_getBlockByNumber",
+                                     ["latest", False])
+        return int(blk["timestamp"], 16)
+
+    def get_transaction(self, txhash: str) -> dict | None:
+        return self.transport.request("eth_getTransactionByHash", [txhash])
 
     def nonce(self) -> int:
         return int(self.transport.request(
@@ -127,11 +139,17 @@ class EngineRpcClient:
     def send(self, fn: str, values: list, *, gas_limit: int = 2_000_000,
              value: int = 0) -> str:
         signature, types = ENGINE_FNS[fn]
+        return self.send_to(self.engine_address, signature, types, values,
+                            gas_limit=gas_limit, value=value)
+
+    def send_to(self, address: str, signature: str, types: list[str],
+                values: list, *, gas_limit: int = 2_000_000,
+                value: int = 0) -> str:
         max_fee, priority = self.gas_fees()
         tx = Eip1559Tx(
             chain_id=self.chain_id, nonce=self.nonce(),
             max_priority_fee_per_gas=priority, max_fee_per_gas=max_fee,
-            gas_limit=gas_limit, to=self.engine_address, value=value,
+            gas_limit=gas_limit, to=address.lower(), value=value,
             data=call_data(signature, types, values))
         raw = tx.sign(self.wallet)
         return self.transport.request("eth_sendRawTransaction",

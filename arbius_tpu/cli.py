@@ -155,6 +155,68 @@ def cmd_demo_mine(args) -> int:
     return 0
 
 
+def cmd_devnet(args) -> int:
+    """Local chain world (setup_local.sh parity): funded devnet over HTTP
+    with a registered model, ready for `node-run` against it."""
+    from arbius_tpu.chain import Engine, TokenLedger, WAD
+    from arbius_tpu.chain.devnet import DevnetNode
+
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=args.start_time)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    node = DevnetNode(eng, chain_id=args.chain_id)
+    for addr in args.fund or []:
+        tok.mint(addr.lower(), 1000 * WAD)
+        print(f"funded {addr} with 1000 AIUS")
+    mid = eng.register_model("0x" + "01" * 20, "0x" + "01" * 20, 0,
+                             b'{"meta":{"title":"devnet"}}')
+    print(json.dumps({
+        "rpc_url": f"http://{args.host}:{args.port}",
+        "engine_address": node.engine_address,
+        "token_address": node.token_address,
+        "chain_id": args.chain_id,
+        "model_id": "0x" + mid.hex(),
+    }, indent=2))
+    server = node.serve(args.host, args.port)
+    print(f"devnet listening on {args.host}:{args.port} (ctrl-c to stop)",
+          file=sys.stderr)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+def cmd_node_run(args) -> int:
+    """Run the miner against a real JSON-RPC endpoint (start.ts parity)."""
+    from arbius_tpu.chain.rpc_client import EngineRpcClient, JsonRpcTransport
+    from arbius_tpu.chain.wallet import Wallet
+    from arbius_tpu.node import MinerNode, load_config
+    from arbius_tpu.node.config import load_deployment
+    from arbius_tpu.node.factory import build_registry
+    from arbius_tpu.node.rpc_chain import RpcChain
+
+    cfg = load_config(open(args.config).read())
+    dep = load_deployment(open(args.deployment).read())
+    key = args.key or open(args.key_file).read().strip()
+    wallet = Wallet.from_hex(key)
+    client = EngineRpcClient(JsonRpcTransport(dep.rpc_url),
+                             dep.engine_address, wallet,
+                             chain_id=dep.chain_id)
+    chain = RpcChain(client, dep.token_address, start_block=dep.start_block)
+    registry = build_registry(cfg)
+    node = MinerNode(chain, cfg, registry)
+    node.boot(skip_self_test=args.skip_self_test)
+    print(f"mining as {wallet.address} against {dep.rpc_url}",
+          file=sys.stderr)
+    if args.ticks > 0:
+        for _ in range(args.ticks):
+            node.tick()
+        return 0
+    node.run()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="arbius-tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -181,6 +243,25 @@ def main(argv=None) -> int:
     sp = sub.add_parser("demo-mine")
     sp.add_argument("--prompt", default="arbius test cat")
     sp.set_defaults(fn=cmd_demo_mine)
+    sp = sub.add_parser("devnet")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8545)
+    sp.add_argument("--chain-id", type=int, default=31337)
+    sp.add_argument("--start-time", type=int, default=1000)
+    sp.add_argument("--fund", action="append",
+                    help="address to mint 1000 AIUS to (repeatable)")
+    sp.set_defaults(fn=cmd_devnet)
+    sp = sub.add_parser("node-run")
+    sp.add_argument("config", help="MiningConfig.json path")
+    sp.add_argument("--deployment", required=True,
+                    help="deployment constants json")
+    keyg = sp.add_mutually_exclusive_group(required=True)
+    keyg.add_argument("--key", help="0x private key")
+    keyg.add_argument("--key-file", help="file holding the private key")
+    sp.add_argument("--skip-self-test", action="store_true")
+    sp.add_argument("--ticks", type=int, default=0,
+                    help="run N ticks then exit (0 = run forever)")
+    sp.set_defaults(fn=cmd_node_run)
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -1,10 +1,11 @@
-"""Minimal Solidity ABI encoder — just the types the protocol hashes use.
+"""Minimal Solidity ABI encoder/decoder — just the types the protocol uses.
 
 The reference computes ids/commitments with ethers' defaultAbiCoder
 (`miner/src/utils.ts:42-49`) matching on-chain abi.encode
 (`contract/contracts/EngineV1.sol:431-438` hashTask, :418-425 hashModel,
-:537-543 generateCommitment). Supported types: address, bytes32, uint256,
-bytes, string. All values are encoded per the standard head/tail layout.
+:537-543 generateCommitment). Supported types: address, bytes32, uintN,
+bool, bytes, string. All values encode per the standard head/tail layout;
+`abi_decode` inverts it for eth_call results and calldata parsing.
 """
 from __future__ import annotations
 
@@ -29,12 +30,14 @@ def _enc_static(typ: str, value) -> bytes:
         if len(v) != want:
             raise ValueError(f"{typ} must be {want} bytes")
         return _pad32(v) if typ == "address" else v
-    if typ in ("uint256", "uint64", "uint8", "uint"):
+    if typ in ("uint256", "uint64", "uint32", "uint8", "uint"):
         v = int(value)
         bits = 256 if typ == "uint" else int(typ[4:])
         if not 0 <= v < (1 << bits):
             raise ValueError(f"value {v} out of range for {typ}")
         return v.to_bytes(32, "big")
+    if typ == "bool":
+        return int(bool(value)).to_bytes(32, "big")
     raise ValueError(f"unsupported static type {typ}")
 
 
@@ -83,3 +86,41 @@ def abi_encode(types: list[str], values: list) -> bytes:
         else:
             out_head.append(h)
     return b"".join(out_head) + b"".join(tail)
+
+
+def _dec_static(typ: str, word: bytes):
+    if typ == "address":
+        return "0x" + word[12:].hex()
+    if typ == "bytes32":
+        return word
+    if typ in ("uint256", "uint64", "uint32", "uint8", "uint"):
+        return int.from_bytes(word, "big")
+    if typ == "bool":
+        return bool(int.from_bytes(word, "big"))
+    raise ValueError(f"unsupported static type {typ}")
+
+
+def abi_decode(types: list[str], data: bytes) -> list:
+    """Inverse of abi_encode over the same type subset.
+
+    Dynamic values (`bytes`, `string`) are resolved through their head
+    offsets; offsets and lengths are bounds-checked so malformed payloads
+    raise instead of silently truncating.
+    """
+    if len(data) < 32 * len(types):
+        raise ValueError("abi data shorter than head")
+    out = []
+    for i, typ in enumerate(types):
+        word = data[32 * i:32 * i + 32]
+        if typ in _DYNAMIC:
+            off = int.from_bytes(word, "big")
+            if off + 32 > len(data):
+                raise ValueError("dynamic offset out of range")
+            n = int.from_bytes(data[off:off + 32], "big")
+            if off + 32 + n > len(data):
+                raise ValueError("dynamic length out of range")
+            v = data[off + 32:off + 32 + n]
+            out.append(v.decode("utf-8") if typ == "string" else v)
+        else:
+            out.append(_dec_static(typ, word))
+    return out

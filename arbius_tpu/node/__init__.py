@@ -8,14 +8,18 @@ from arbius_tpu.node.chain_client import LocalChain
 from arbius_tpu.node.config import (
     AutomineConfig,
     ConfigError,
+    DeploymentConfig,
     MiningConfig,
     ModelConfig,
     StakeConfig,
     load_config,
+    load_deployment,
 )
 from arbius_tpu.node.db import Job, NodeDB
+from arbius_tpu.node.factory import build_registry
 from arbius_tpu.node.node import BootError, MinerNode, NodeMetrics
 from arbius_tpu.node.retry import RetriesExhausted, expretry
+from arbius_tpu.node.rpc_chain import ChainRpcError, RpcChain
 from arbius_tpu.node.solver import (
     Kandinsky2Runner,
     ModelRegistry,
@@ -28,10 +32,11 @@ from arbius_tpu.node.solver import (
 )
 
 __all__ = [
-    "AutomineConfig", "BootError", "ConfigError", "Job",
-    "Kandinsky2Runner", "LocalChain", "MinerNode", "MiningConfig",
-    "ModelConfig", "ModelRegistry", "NodeDB", "NodeMetrics", "RVMRunner",
-    "RegisteredModel", "RetriesExhausted", "SD15Runner", "StakeConfig",
-    "Text2VideoRunner", "expretry", "load_config", "solve_cid",
-    "solve_files",
+    "AutomineConfig", "BootError", "ChainRpcError", "ConfigError",
+    "DeploymentConfig", "Job", "Kandinsky2Runner", "LocalChain",
+    "MinerNode", "MiningConfig", "ModelConfig", "ModelRegistry", "NodeDB",
+    "NodeMetrics", "RVMRunner", "RegisteredModel", "RetriesExhausted",
+    "RpcChain", "SD15Runner", "StakeConfig", "Text2VideoRunner",
+    "build_registry", "expretry", "load_config", "load_deployment",
+    "solve_cid", "solve_files",
 ]

@@ -25,6 +25,8 @@ class ModelConfig:
     enabled: bool = True
     min_fee: int = 0              # wad; checkModelFilter mirror
     allowed_owners: tuple[str, ...] = ()
+    checkpoint: str | None = None  # orbax param dir (None: random init)
+    tiny: bool = False             # reduced topology (dev/CI hosts)
 
 
 @dataclass(frozen=True)
@@ -62,6 +64,31 @@ class MiningConfig:
     profile_dir: str | None = None   # jax.profiler trace output dir
     profile_every: int = 0           # trace every Nth solve dispatch
     compile_cache_dir: str | None = ".jax_cache"  # persistent XLA cache
+
+
+@dataclass(frozen=True)
+class DeploymentConfig:
+    """Tier-1 deployment constants (the reference's `src/config.json:1-24`):
+    where the chain lives and which contracts to talk to. Operator config
+    (MiningConfig) says how to mine; this says where."""
+    rpc_url: str
+    engine_address: str
+    token_address: str
+    chain_id: int
+    start_block: int = 0          # poll_events starts here
+
+
+def load_deployment(raw: str | dict) -> DeploymentConfig:
+    obj = json.loads(raw) if isinstance(raw, str) else dict(raw)
+    known = set(DeploymentConfig.__dataclass_fields__)
+    unknown = set(obj) - known
+    if unknown:
+        raise ConfigError(f"unknown deployment keys: {sorted(unknown)}")
+    missing = {"rpc_url", "engine_address", "token_address",
+               "chain_id"} - set(obj)
+    if missing:
+        raise ConfigError(f"deployment config missing: {sorted(missing)}")
+    return DeploymentConfig(**obj)
 
 
 _KNOWN = {f for f in MiningConfig.__dataclass_fields__}

@@ -1,0 +1,118 @@
+"""Registry factory — MiningConfig → live ModelRegistry.
+
+The reference's equivalent is `EnabledModels` + `getModelById`
+(`miner/src/index.ts:781-877`, `models.ts:87-98`): a static table wiring
+template → container invocation. Here each template name maps to its
+in-process pipeline class; params come from an orbax checkpoint when the
+model entry names one (the converted production weights) or from
+deterministic random init otherwise (dev / throughput benches — same
+FLOPs, no weights download).
+"""
+from __future__ import annotations
+
+import logging
+
+from arbius_tpu.node.config import MiningConfig, ModelConfig
+from arbius_tpu.node.solver import (
+    Kandinsky2Runner,
+    ModelRegistry,
+    RegisteredModel,
+    RVMRunner,
+    SD15Runner,
+    Text2VideoRunner,
+)
+from arbius_tpu.templates.engine import load_template
+
+log = logging.getLogger("arbius.factory")
+
+
+def _params_for(pipe, m: ModelConfig):
+    if m.checkpoint:
+        from arbius_tpu.utils import load_params
+
+        return load_params(m.checkpoint)
+    log.warning("model %s: no checkpoint configured, using random init",
+                m.id)
+    return pipe.init_params(seed=0)
+
+
+def _sd15(m: ModelConfig, mesh):
+    from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline
+
+    cfg = SD15Config.tiny() if m.tiny else SD15Config()
+    tok = tiny_byte_tokenizer(cfg.text) if m.tiny else None
+    pipe = SD15Pipeline(cfg, tokenizer=tok, mesh=mesh)
+    return SD15Runner(pipe, _params_for(pipe, m))
+
+
+def tiny_byte_tokenizer(text_cfg):
+    """Byte tokenizer whose special ids fit a reduced-vocab text tower —
+    the one way to build a tiny-config tokenizer (bench.py uses it too)."""
+    from arbius_tpu.models.sd15 import ByteTokenizer
+
+    return ByteTokenizer(max_length=text_cfg.max_length,
+                         bos_id=257, eos_id=258)
+
+
+def _kandinsky2(m: ModelConfig, mesh):
+    from arbius_tpu.models.kandinsky2 import Kandinsky2Config, Kandinsky2Pipeline
+
+    cfg = Kandinsky2Config.tiny() if m.tiny else Kandinsky2Config()
+    tok = tiny_byte_tokenizer(cfg.text) if m.tiny else None
+    pipe = Kandinsky2Pipeline(cfg, tokenizer=tok, mesh=mesh)
+    return Kandinsky2Runner(pipe, _params_for(pipe, m))
+
+
+def _video(m: ModelConfig, mesh):
+    from arbius_tpu.models.video import Text2VideoConfig, Text2VideoPipeline
+
+    cfg = Text2VideoConfig.tiny() if m.tiny else Text2VideoConfig()
+    tok = tiny_byte_tokenizer(cfg.text) if m.tiny else None
+    pipe = Text2VideoPipeline(cfg, tokenizer=tok, mesh=mesh)
+    return Text2VideoRunner(pipe, _params_for(pipe, m))
+
+
+def _rvm(m: ModelConfig, mesh, resolve_file):
+    from arbius_tpu.models.rvm import RVMPipeline, RVMPipelineConfig
+
+    cfg = RVMPipelineConfig.tiny() if m.tiny else RVMPipelineConfig()
+    pipe = RVMPipeline(cfg)
+    return RVMRunner(pipe, _params_for(pipe, m), resolve_file)
+
+
+_BUILDERS = {
+    "anythingv3": _sd15,
+    "kandinsky2": _kandinsky2,
+    "zeroscopev2xl": _video,
+    "damo": _video,
+}
+
+
+def build_registry(cfg: MiningConfig, *, mesh=None,
+                   resolve_file=None) -> ModelRegistry:
+    """Construct runners for every enabled model in the config.
+
+    `resolve_file` (cid → bytes) is required only for file-input
+    templates (robust_video_matting); leave None to skip those with a
+    warning rather than fail the whole node.
+    """
+    reg = ModelRegistry()
+    for m in cfg.models:
+        if not m.enabled:
+            continue
+        if m.template == "robust_video_matting":
+            if resolve_file is None:
+                log.warning("model %s: robust_video_matting needs a "
+                            "resolve_file; skipping", m.id)
+                continue
+            runner = _rvm(m, mesh, resolve_file)
+        elif m.template in _BUILDERS:
+            runner = _BUILDERS[m.template](m, mesh)
+        else:
+            log.warning("model %s: unknown template %r; skipping",
+                        m.id, m.template)
+            continue
+        reg.register(RegisteredModel(
+            id=m.id, template=load_template(m.template), runner=runner,
+            min_fee=m.min_fee, allowed_owners=list(m.allowed_owners)))
+    return reg

@@ -183,6 +183,16 @@ class MinerNode:
     def tick(self) -> int:
         """One poll: run due concurrent jobs, then one serial pass.
         Returns number of jobs processed."""
+        # pull-based backends (RpcChain) deliver events here; the local
+        # engine pushes synchronously and has no poll_events. A transport
+        # blip must not kill the run() loop — the next tick re-polls the
+        # same range (handlers dedupe replayed events).
+        poll = getattr(self.chain, "poll_events", None)
+        if poll is not None:
+            try:
+                poll()
+            except Exception as e:  # noqa: BLE001 — endpoint flake
+                log.warning("event poll failed (will retry): %r", e)
         jobs = self.db.get_jobs(self.chain.now)
         if not jobs:
             return 0

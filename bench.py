@@ -126,8 +126,12 @@ def main() -> None:
         width, height, steps = 128, 128, 4
         cfg = SD15Config.tiny()
 
-    tok = ByteTokenizer() if on_tpu else ByteTokenizer(
-        max_length=cfg.text.max_length, bos_id=257, eos_id=258)
+    if on_tpu:
+        tok = ByteTokenizer()
+    else:
+        from arbius_tpu.node.factory import tiny_byte_tokenizer
+
+        tok = tiny_byte_tokenizer(cfg.text)
     pipe = SD15Pipeline(cfg, mesh=mesh, tokenizer=tok)
     params = pipe.place_params(pipe.init_params(seed=0,
                                                 height=height, width=width))

@@ -1,0 +1,270 @@
+"""Live-chain mining path: RpcChain over a devnet speaking real signed txs.
+
+The reference only exercises its signing stack against live Nova
+(`miner/test/utils.test.ts:60-69`); here the loop closes hermetically:
+wallet signs EIP-1559 → RLP bytes → DevnetNode RLP-decodes, recovers the
+sender from the secp256k1 signature, ABI-decodes calldata, applies it to
+the in-process EngineV1 — then the node reads it all back through
+eth_call/eth_getLogs. End-to-end: MinerNode mines a task through the
+full JSON-RPC surface with zero LocalChain shortcuts.
+"""
+import json
+import threading
+
+import pytest
+
+from arbius_tpu.chain import Engine, EngineError, TokenLedger, WAD
+from arbius_tpu.chain.devnet import DevnetNode, DevnetError
+from arbius_tpu.chain.rlp import Eip1559Tx, decode_signed_eip1559, rlp_decode, rlp_encode
+from arbius_tpu.chain.rpc_client import EngineRpcClient, JsonRpcTransport
+from arbius_tpu.chain.wallet import Wallet
+from arbius_tpu.l0.abi import abi_decode, abi_encode
+from arbius_tpu.node.rpc_chain import RpcChain
+
+CHAIN_ID = 31337
+KEY_MINER = "0x" + "11" * 32
+KEY_USER = "0x" + "22" * 32
+
+
+class DevnetTransport:
+    """Transport-shim: JsonRpcTransport semantics without HTTP."""
+
+    def __init__(self, node: DevnetNode):
+        self.node = node
+
+    def request(self, method, params):
+        from arbius_tpu.chain.rpc_client import RpcError
+
+        try:
+            return self.node.request(method, params)
+        except DevnetError as e:
+            raise RpcError(str(e)) from None
+
+
+def make_world():
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=1000)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    dev = DevnetNode(eng, chain_id=CHAIN_ID)
+    miner, user = Wallet.from_hex(KEY_MINER), Wallet.from_hex(KEY_USER)
+    tok.mint(miner.address, 1000 * WAD)
+    tok.mint(user.address, 1000 * WAD)
+    mid = eng.register_model(user.address, user.address, 0,
+                             b'{"meta":{"title":"t"}}')
+    return eng, dev, miner, user, "0x" + mid.hex()
+
+
+def make_chain(dev, wallet):
+    client = EngineRpcClient(DevnetTransport(dev), dev.engine_address,
+                             wallet, chain_id=CHAIN_ID)
+    return RpcChain(client, dev.token_address)
+
+
+# -- primitives ----------------------------------------------------------
+
+def test_rlp_decode_roundtrip():
+    cases = [b"", b"\x01", b"dog", b"a" * 60, [b"cat", [b"", b"\x7f"]],
+             [], [b"x" * 300, [b"y"] * 20]]
+    for item in cases:
+        assert rlp_decode(rlp_encode(item)) == item
+    with pytest.raises(ValueError):
+        rlp_decode(rlp_encode(b"dog") + b"\x00")
+    with pytest.raises(ValueError):
+        rlp_decode(b"\x85abc")  # declares 5 bytes, provides 3
+    with pytest.raises(ValueError):
+        rlp_decode(b"\xc5\x83do")  # list payload truncated
+
+
+def test_signed_tx_decode_recovers_sender():
+    w = Wallet.from_hex(KEY_MINER)
+    tx = Eip1559Tx(chain_id=CHAIN_ID, nonce=7, max_priority_fee_per_gas=1,
+                   max_fee_per_gas=100, gas_limit=21000,
+                   to="0x" + "e1" * 20, value=5, data=b"\xde\xad")
+    dec = decode_signed_eip1559(tx.sign(w))
+    assert dec.sender == w.address
+    assert dec.tx == tx
+    assert dec.tx_hash == tx.tx_hash(w)
+
+
+def test_abi_decode_roundtrip():
+    types = ["address", "bytes32", "uint256", "bool", "bytes", "string",
+             "uint64", "uint8"]
+    values = ["0x" + "ab" * 20, b"\x01" * 32, 2**200, True, b"xyz" * 30,
+              "hello", 2**40, 7]
+    assert abi_decode(types, abi_encode(types, values)) == values
+    with pytest.raises(ValueError):
+        abi_decode(["uint256"], b"\x00" * 16)
+
+
+# -- devnet JSON-RPC surface ----------------------------------------------
+
+def test_devnet_signed_task_submission_updates_engine():
+    eng, dev, miner, user, mid = make_world()
+    client = EngineRpcClient(DevnetTransport(dev), dev.engine_address,
+                             user, chain_id=CHAIN_ID)
+    input_bytes = json.dumps({"prompt": "hi"}).encode()
+    client.send("submitTask", [0, user.address, mid, 0, input_bytes])
+    assert len(eng.tasks) == 1
+    tid = next(iter(eng.tasks))
+    # view read-back through eth_call
+    raw = client.eth_call("tasks(bytes32)", ["bytes32"], ["0x" + tid.hex()])
+    model, fee, owner, blocktime, version, cid = abi_decode(
+        ["bytes32", "uint256", "address", "uint64", "uint8", "bytes"], raw)
+    assert model == bytes.fromhex(mid[2:]) and owner == user.address.lower()
+    # the input rides the calldata, recoverable via the logged tx
+    logs = client.get_logs("TaskSubmitted", 0, dev.engine.block_number)
+    assert len(logs) == 1
+    tx = client.get_transaction(logs[0]["transactionHash"])
+    assert bytes.fromhex(tx["input"][2:]).endswith(b"\x00" * 0 + input_bytes
+                                                   .ljust((len(input_bytes) + 31) // 32 * 32, b"\x00"))
+
+
+def test_devnet_rejects_wrong_nonce_and_bad_chain_id():
+    eng, dev, miner, user, mid = make_world()
+    tx = Eip1559Tx(chain_id=CHAIN_ID, nonce=5, max_priority_fee_per_gas=1,
+                   max_fee_per_gas=2, gas_limit=100000,
+                   to=dev.engine_address, value=0,
+                   data=bytes.fromhex("00000000"))
+    with pytest.raises(DevnetError, match="nonce"):
+        dev.request("eth_sendRawTransaction",
+                    ["0x" + tx.sign(miner).hex()])
+    tx2 = Eip1559Tx(chain_id=999, nonce=0, max_priority_fee_per_gas=1,
+                    max_fee_per_gas=2, gas_limit=100000,
+                    to=dev.engine_address, value=0, data=b"\x00" * 4)
+    with pytest.raises(DevnetError, match="chain id"):
+        dev.request("eth_sendRawTransaction",
+                    ["0x" + tx2.sign(miner).hex()])
+
+
+def test_devnet_http_transport():
+    eng, dev, miner, user, mid = make_world()
+    server = dev.serve("127.0.0.1", 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        tr = JsonRpcTransport(f"http://127.0.0.1:{port}")
+        assert int(tr.request("eth_blockNumber", []), 16) >= 1
+        client = EngineRpcClient(tr, dev.engine_address, user,
+                                 chain_id=CHAIN_ID)
+        client.send("submitTask", [0, user.address, mid, 0, b"{}"])
+        assert len(eng.tasks) == 1
+        from arbius_tpu.chain.rpc_client import RpcError
+
+        with pytest.raises(RpcError, match="revert"):
+            client.send("claimSolution", ["0x" + "77" * 32])
+    finally:
+        server.shutdown()
+
+
+# -- RpcChain facade ------------------------------------------------------
+
+def test_rpc_chain_reads_and_none_mapping():
+    eng, dev, miner, user, mid = make_world()
+    chain = make_chain(dev, miner)
+    assert chain.get_task("0x" + "00" * 32) is None
+    assert chain.get_solution("0x" + "00" * 32) is None
+    assert chain.get_contestation("0x" + "00" * 32) is None
+    assert chain.version() == 0
+    assert chain.token_balance() == 1000 * WAD
+    assert chain.validator_staked() == 0
+    assert chain.min_claim_solution_time() == eng.min_claim_solution_time
+    assert chain.now == eng.now
+
+
+def test_rpc_chain_validator_deposit_self_heals_allowance():
+    eng, dev, miner, user, mid = make_world()
+    chain = make_chain(dev, miner)
+    assert chain.token_allowance(dev.engine_address) == 0
+    chain.validator_deposit(10 * WAD)
+    assert chain.validator_staked() == 10 * WAD
+    assert chain.token_allowance(dev.engine_address) > 0
+
+
+def test_rpc_chain_revert_maps_to_engine_error():
+    eng, dev, miner, user, mid = make_world()
+    chain = make_chain(dev, miner)
+    with pytest.raises(EngineError):
+        chain.claim_solution("0x" + "42" * 32)
+
+
+def test_rpc_chain_event_polling_decodes_args():
+    eng, dev, miner, user, mid = make_world()
+    chain = make_chain(dev, miner)
+    seen = []
+    chain.subscribe(lambda ev: seen.append(ev))
+    user_chain = make_chain(dev, user)
+    user_chain.submit_task(0, user.address, mid, 0,
+                           json.dumps({"prompt": "x"}).encode())
+    n = chain.poll_events()
+    assert n == 1 and seen[0].name == "TaskSubmitted"
+    args = seen[0].args
+    tid = "0x" + args["id"].hex()
+    assert args["sender"] == user.address.lower()
+    assert args["fee"] == 0
+    assert isinstance(args["model"], bytes)
+    # input bytes recovered from the submitting tx's calldata
+    assert chain.get_task_input_bytes(tid) == \
+        json.dumps({"prompt": "x"}).encode()
+    # replays are not re-delivered
+    assert chain.poll_events() == 0
+
+
+def test_miner_node_mines_end_to_end_over_rpc():
+    """The VERDICT's done-criterion: the node mines through a fake JSON-RPC
+    chain — poll logs → hydrate → solve (tiny SD-1.5) → signed commit →
+    signed reveal → time travel → signed claim."""
+    from arbius_tpu.node import MinerNode, MiningConfig, ModelConfig, build_registry
+
+    eng, dev, miner, user, mid = make_world()
+    chain = make_chain(dev, miner)
+    cfg = MiningConfig(
+        models=(ModelConfig(id=mid, template="anythingv3", tiny=True),),
+        compile_cache_dir=None)
+    node = MinerNode(chain, cfg, build_registry(cfg))
+    node.boot(skip_self_test=True)
+
+    user_chain = make_chain(dev, user)
+    user_chain.submit_task(0, user.address, mid, 0, json.dumps({
+        "prompt": "arbius test cat", "negative_prompt": "",
+        "width": 128, "height": 128, "num_inference_steps": 2,
+        "scheduler": "DDIM"}).encode())
+
+    for _ in range(6):
+        node.tick()
+    tid_b = next(iter(eng.tasks))
+    sol = eng.solutions.get(tid_b)
+    assert sol is not None, "node did not submit a solution over RPC"
+    assert sol.validator == miner.address.lower()
+    assert sol.cid.startswith(b"\x12\x20")
+    # the stake job must have topped us up through the signed-tx path
+    assert chain.validator_staked() >= eng.get_validator_minimum()
+
+    dev.request("evm_increaseTime", [eng.min_claim_solution_time + 200])
+    dev.request("evm_mine", [])
+    for _ in range(4):
+        node.tick()
+    assert eng.solutions[tid_b].claimed
+    assert node.metrics.solutions_claimed == 1
+
+
+def test_rpc_chain_full_commit_reveal_claim():
+    eng, dev, miner, user, mid = make_world()
+    chain = make_chain(dev, miner)
+    chain.validator_deposit(100 * WAD)
+    user_chain = make_chain(dev, user)
+    user_chain.submit_task(0, user.address, mid, 0, b"{}")
+    chain.poll_events()
+    tid = "0x" + next(iter(eng.tasks)).hex()
+    cid = "0x1220" + "ab" * 32
+    commitment = chain.generate_commitment(tid, cid)
+    chain.signal_commitment(commitment)
+    chain.submit_solution(tid, cid)
+    sol = chain.get_solution(tid)
+    assert sol is not None and sol.validator == miner.address.lower()
+    dev.request("evm_increaseTime", [eng.min_claim_solution_time + 100])
+    dev.request("evm_mine", [])
+    before = chain.token_balance()
+    chain.claim_solution(tid)
+    assert eng.solutions[next(iter(eng.tasks))].claimed
+    assert chain.token_balance() >= before
