@@ -185,6 +185,9 @@ class DevnetNode:
                 [], ["uint256"], lambda v: [eng.get_validator_minimum()]),
             _selector("minClaimSolutionTime()"): (
                 [], ["uint256"], lambda v: [eng.min_claim_solution_time]),
+            _selector("minContestationVotePeriodTime()"): (
+                [], ["uint256"],
+                lambda v: [eng.min_contestation_vote_period_time]),
             _selector("version()"): (
                 [], ["uint256"], lambda v: [eng.version]),
             _selector("prevhash()"): (

@@ -204,9 +204,23 @@ def cmd_node_run(args) -> int:
                              dep.engine_address, wallet,
                              chain_id=dep.chain_id)
     chain = RpcChain(client, dep.token_address, start_block=dep.start_block)
-    registry = build_registry(cfg)
-    node = MinerNode(chain, cfg, registry)
+    store = None
+    if cfg.store_dir:
+        from arbius_tpu.node.store import ContentStore
+
+        store = ContentStore(cfg.store_dir)
+    registry = build_registry(
+        cfg, resolve_file=store.get_file if store else None)
+    node = MinerNode(chain, cfg, registry, store=store)
     node.boot(skip_self_test=args.skip_self_test)
+    rpc = None
+    if cfg.rpc_port is not None:
+        from arbius_tpu.node.rpc import ControlRPC
+
+        rpc = ControlRPC(node, port=cfg.rpc_port)
+        rpc.start()
+        print(f"control RPC + explorer on 127.0.0.1:{rpc.port}",
+              file=sys.stderr)
     print(f"mining as {wallet.address} against {dep.rpc_url}",
           file=sys.stderr)
     if args.ticks > 0:

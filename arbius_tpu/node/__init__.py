@@ -18,8 +18,10 @@ from arbius_tpu.node.config import (
 from arbius_tpu.node.db import Job, NodeDB
 from arbius_tpu.node.factory import build_registry
 from arbius_tpu.node.node import BootError, MinerNode, NodeMetrics
+from arbius_tpu.node.pinners import HttpDaemonPinner, LocalPinner, PinMismatchError
 from arbius_tpu.node.retry import RetriesExhausted, expretry
 from arbius_tpu.node.rpc_chain import ChainRpcError, RpcChain
+from arbius_tpu.node.store import ContentStore, cid_b58
 from arbius_tpu.node.solver import (
     Kandinsky2Runner,
     ModelRegistry,
@@ -33,10 +35,11 @@ from arbius_tpu.node.solver import (
 
 __all__ = [
     "AutomineConfig", "BootError", "ChainRpcError", "ConfigError",
-    "DeploymentConfig", "Job", "Kandinsky2Runner", "LocalChain",
-    "MinerNode", "MiningConfig", "ModelConfig", "ModelRegistry", "NodeDB",
-    "NodeMetrics", "RVMRunner", "RegisteredModel", "RetriesExhausted",
-    "RpcChain", "SD15Runner", "StakeConfig", "Text2VideoRunner",
-    "build_registry", "expretry", "load_config", "load_deployment",
-    "solve_cid", "solve_files",
+    "ContentStore", "DeploymentConfig", "HttpDaemonPinner", "Job",
+    "Kandinsky2Runner", "LocalChain", "LocalPinner", "MinerNode",
+    "MiningConfig", "ModelConfig", "ModelRegistry", "NodeDB",
+    "NodeMetrics", "PinMismatchError", "RVMRunner", "RegisteredModel",
+    "RetriesExhausted", "RpcChain", "SD15Runner", "StakeConfig",
+    "Text2VideoRunner", "build_registry", "cid_b58", "expretry",
+    "load_config", "load_deployment", "solve_cid", "solve_files",
 ]

@@ -67,6 +67,9 @@ class LocalChain:
     def min_claim_solution_time(self) -> int:
         return self.engine.min_claim_solution_time
 
+    def min_contestation_vote_period(self) -> int:
+        return self.engine.min_contestation_vote_period_time
+
     def token_balance(self) -> int:
         return self.engine.token.balance_of(self.address)
 

@@ -57,6 +57,12 @@ class MiningConfig:
     automine: AutomineConfig = AutomineConfig()
     stake: StakeConfig = StakeConfig()
     claim_delay_buffer: int = 120  # claim at solution+minClaimTime+this
+    vote_finish_delay_buffer: int = 120  # finish at contest+votePeriod+this
+    # profitability gate: skip tasks whose fee < estimated_solve_seconds *
+    # this rate (wad/second). 0 disables (reference behavior: fee filters
+    # only, no cost model)
+    min_fee_per_second: int = 0
+    assumed_solve_seconds: float = 10.0  # cost estimate before any samples
     poll_interval_ms: int = 100    # main-loop cadence (index.ts:1082-1096)
     # dp batch per solve dispatch; MUST be fleet-wide per model class
     # (batch size is part of the XLA program = the determinism class)
@@ -64,6 +70,8 @@ class MiningConfig:
     profile_dir: str | None = None   # jax.profiler trace output dir
     profile_every: int = 0           # trace every Nth solve dispatch
     compile_cache_dir: str | None = ".jax_cache"  # persistent XLA cache
+    store_dir: str | None = None     # content store root (None: don't pin)
+    rpc_port: int | None = None      # control RPC + explorer + /ipfs gateway
 
 
 @dataclass(frozen=True)

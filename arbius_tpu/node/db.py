@@ -82,6 +82,13 @@ class NodeDB:
             self._conn.commit()
             return cur.lastrowid
 
+    def has_job(self, method: str, data: dict) -> bool:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COUNT(*) AS n FROM jobs WHERE method = ? AND data = ?",
+                (method, json.dumps(data, sort_keys=True))).fetchone()
+            return row["n"] > 0
+
     def get_jobs(self, now: int, limit: int = 100) -> list[Job]:
         with self._lock:
             rows = self._conn.execute(

@@ -52,6 +52,8 @@ class NodeMetrics:
     solutions_claimed: int = 0
     contestations_submitted: int = 0
     votes_cast: int = 0
+    vote_finishes: int = 0
+    tasks_unprofitable: int = 0
     tasks_seen: int = 0
     tasks_invalid: int = 0
     # rolling windows (deque maxlen): percentiles reflect RECENT behavior
@@ -70,11 +72,17 @@ class BootError(RuntimeError):
 
 class MinerNode:
     def __init__(self, chain: LocalChain, config: MiningConfig,
-                 registry: ModelRegistry, db: NodeDB | None = None):
+                 registry: ModelRegistry, db: NodeDB | None = None,
+                 store=None):
         self.chain = chain
         self.config = config
         self.registry = registry
         self.db = db or NodeDB(config.db_path)
+        if store is None and config.store_dir:
+            from arbius_tpu.node.store import ContentStore
+
+            store = ContentStore(config.store_dir)
+        self.store = store
         self.metrics = NodeMetrics()
         self._retry_sleep = lambda s: None  # injectable; chain time is fake
 
@@ -163,6 +171,12 @@ class MinerNode:
     def _on_contestation(self, args: dict) -> None:
         taskid = "0x" + args["task"].hex()
         self.db.store_contestation(taskid, args["addr"], self.chain.now)
+        # if we are the accused solver the engine auto-nay-voted for us
+        # (EngineV1.sol:922-934) — our escrow is locked until the vote
+        # finishes, so schedule the finish ourselves
+        sol = self.chain.get_solution(taskid)
+        if sol is not None and sol.validator == self.chain.address:
+            self._queue_vote_finish(taskid)
         if args["addr"] == self.chain.address:
             return
         if self.db.is_invalid_task(taskid):
@@ -219,7 +233,8 @@ class MinerNode:
                 "vote": self._process_vote,
                 "validatorStake": self._process_validator_stake,
                 "automine": self._process_automine,
-                "pinTaskInput": lambda d: None,  # input mirroring: no-op
+                "pinTaskInput": self._process_pin_task_input,
+                "voteFinish": self._process_vote_finish,
             }.get(job.method)
             if handler is None:
                 log.error("unknown job method %s", job.method)
@@ -257,6 +272,11 @@ class MinerNode:
             owner=task.owner)
         if not result.filter_passed:
             return
+        if not self._fee_covers_cost(task.fee):
+            self.metrics.tasks_unprofitable += 1
+            log.info("task %s fee %d below cost floor — skipping",
+                     taskid, task.fee)
+            return
         raw = self.chain.get_task_input_bytes(taskid)
         if raw is None:
             raise ValueError(f"no input bytes for {taskid}")
@@ -271,8 +291,28 @@ class MinerNode:
             return
         hydrated["seed"] = taskid2seed(taskid)
         self.db.store_task_input(taskid, "", hydrated)
+        if self.store is not None:
+            # mirror the raw input so contestation evidence stays
+            # retrievable (index.ts:175-186 pinTaskInput)
+            self.db.queue_job("pinTaskInput", {"taskid": taskid},
+                              concurrent=True)
         self.db.queue_job("solve", {"taskid": taskid, "model": model_id},
                           concurrent=False)
+
+    def _fee_covers_cost(self, fee: int) -> bool:
+        """Profitability gate (beyond the reference's static fee filter):
+        estimated solve seconds × operator rate must not exceed the fee.
+        Estimate = observed infer p50, or the configured prior before any
+        samples. Disabled at rate 0."""
+        rate = self.config.min_fee_per_second
+        if rate <= 0:
+            return True
+        samples = self.metrics.stage_seconds["infer"]
+        if samples:
+            est = sorted(samples)[len(samples) // 2]
+        else:
+            est = self.config.assumed_solve_seconds
+        return fee >= int(est * rate)
 
     def _bucket_key(self, model_id: str, hydrated: dict) -> tuple:
         return (model_id, hydrated.get("width"), hydrated.get("height"),
@@ -311,7 +351,8 @@ class MinerNode:
             self.metrics.stage_seconds["infer"].append(
                 time.perf_counter() - w_start)
             w_commit = time.perf_counter()
-            for (job, _), (cid, _files) in zip(entries, results):
+            for (job, _), (cid, files) in zip(entries, results):
+                self._store_solution(cid, files)
                 try:
                     self._commit_reveal(job.data["taskid"], cid, t_start)
                     self.db.delete_job(job.id)
@@ -322,6 +363,28 @@ class MinerNode:
             self.metrics.stage_seconds["commit"].append(
                 time.perf_counter() - w_commit)
         return done
+
+    def _store_solution(self, cid: str, files: dict) -> None:
+        """Persist solution bytes under their CID (data availability: the
+        committed CID must be fetchable — ipfs.ts:28-76 equivalent)."""
+        if self.store is None or not files:
+            return
+        from arbius_tpu.l0.cid import cid_hex
+
+        stored = cid_hex(self.store.put_files(files))
+        if stored != cid:
+            # same pure function on the same bytes; a mismatch means disk
+            # corruption or a codec bug — keep mining but say so loudly
+            log.error("store/commit CID mismatch: %s != %s", stored, cid)
+
+    def _process_pin_task_input(self, data: dict) -> None:
+        """Mirror the raw task input into the content store."""
+        if self.store is None:
+            return
+        raw = self.chain.get_task_input_bytes(data["taskid"])
+        if raw is None:
+            raise ValueError(f"no input bytes for {data['taskid']}")
+        self.store.put_blob(raw)
 
     def _maybe_profile(self):
         """jax.profiler trace around every Nth solve dispatch when the
@@ -388,11 +451,13 @@ class MinerNode:
         try:
             self.chain.submit_contestation(taskid)
             self.metrics.contestations_submitted += 1
+            self._queue_vote_finish(taskid)
         except EngineError:
             if not self.chain.contestation_voted(taskid) and \
                     self.chain.validator_can_vote(taskid) == 0:
                 self.chain.vote_on_contestation(taskid, True)
                 self.metrics.votes_cast += 1
+                self._queue_vote_finish(taskid)
 
     def _process_vote(self, data: dict) -> None:
         """index.ts:709-726."""
@@ -403,6 +468,44 @@ class MinerNode:
             return
         self.chain.vote_on_contestation(taskid, data["yea"])
         self.metrics.votes_cast += 1
+        self._queue_vote_finish(taskid)
+
+    def _queue_vote_finish(self, taskid: str) -> None:
+        """Schedule contestationVoteFinish after the vote window for a
+        contestation we have a stake in. The reference leaves this as a
+        stub (index.ts:392-395 'not implemented yet'), which strands every
+        participant's escrowed slash until some human calls finish."""
+        c = self.chain.get_contestation(taskid)
+        if c is None:
+            return
+        data = {"taskid": taskid}
+        if self.db.has_job("voteFinish", data):
+            return
+        due = c.blocktime + self.chain.min_contestation_vote_period() \
+            + self.config.vote_finish_delay_buffer
+        self.db.queue_job("voteFinish", data, waituntil=due)
+
+    def _process_vote_finish(self, data: dict) -> None:
+        """Finish the contestation vote (EngineV1.sol:1026-1106), paying
+        out escrows pageful-by-pageful. Racing other finishers is fine —
+        the pagination index advances on-chain."""
+        taskid = data["taskid"]
+        c = self.chain.get_contestation(taskid)
+        if c is None:
+            return
+        period = self.chain.min_contestation_vote_period()
+        if self.chain.now < c.blocktime + period:
+            # clock skew between scheduling and chain time — push it back
+            self.db.queue_job(
+                "voteFinish", data,
+                waituntil=c.blocktime + period
+                + self.config.vote_finish_delay_buffer)
+            return
+        try:
+            self.chain.contestation_vote_finish(taskid, 64)
+            self.metrics.vote_finishes += 1
+        except EngineError as e:
+            log.info("voteFinish %s: %r (already finished?)", taskid, e)
 
     def _process_validator_stake(self, data: dict) -> None:
         """Auto top-up (index.ts:397-472) with the 1%/20% buffers, then

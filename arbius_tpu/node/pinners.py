@@ -1,0 +1,84 @@
+"""Pinning strategies — local store or remote daemon, one interface.
+
+The reference switches on `c.ipfs.strategy` between an ipfs-http-client
+daemon and Pinata's HTTP API (`miner/src/ipfs.ts:28-76`, `:79-114`).
+Same split here: `LocalPinner` persists into the node's own ContentStore
+(the default — the node serves its own gateway), `HttpDaemonPinner`
+POSTs to a kubo-style `/api/v0/add` endpoint. Both return the root CID,
+and the HTTP pinner VERIFIES the daemon's answer against the locally
+computed CID — a daemon that hashes differently would otherwise make the
+node commit a CID whose bytes it can't prove.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Protocol
+
+from arbius_tpu.l0.base58 import b58encode
+from arbius_tpu.l0.cid import cid_of_solution_files
+from arbius_tpu.node.store import ContentStore
+
+
+class Pinner(Protocol):
+    def pin_files(self, files: dict[str, bytes]) -> bytes:
+        """Persist a solution's files; return the dir-wrapped root CID."""
+        ...
+
+
+class LocalPinner:
+    def __init__(self, store: ContentStore):
+        self.store = store
+
+    def pin_files(self, files: dict[str, bytes]) -> bytes:
+        return self.store.put_files(files)
+
+
+class PinMismatchError(RuntimeError):
+    """Remote daemon returned a different root CID than computed locally."""
+
+
+class HttpDaemonPinner:
+    """kubo `/api/v0/add` with the reference's exact options
+    (`miner/src/ipfs.ts:11-16`): cid-version=0, sha2-256, 262144 chunker,
+    rawLeaves=false, wrap-with-directory. `opener` is injectable for
+    tests (zero-egress environment)."""
+
+    BOUNDARY = "arbius-tpu-multipart"
+
+    def __init__(self, api_url: str, timeout: float = 60.0, opener=None):
+        self.api_url = api_url.rstrip("/")
+        self.timeout = timeout
+        self.opener = opener or urllib.request.urlopen
+
+    def _multipart(self, files: dict[str, bytes]) -> bytes:
+        parts = []
+        for name in sorted(files):
+            parts.append(
+                (f"--{self.BOUNDARY}\r\n"
+                 f'Content-Disposition: form-data; name="file"; '
+                 f'filename="{name}"\r\n'
+                 "Content-Type: application/octet-stream\r\n\r\n"
+                 ).encode() + files[name] + b"\r\n")
+        parts.append(f"--{self.BOUNDARY}--\r\n".encode())
+        return b"".join(parts)
+
+    def pin_files(self, files: dict[str, bytes]) -> bytes:
+        local_root = cid_of_solution_files(files)
+        query = ("cid-version=0&hash=sha2-256&chunker=size-262144"
+                 "&raw-leaves=false&wrap-with-directory=true&pin=true")
+        req = urllib.request.Request(
+            f"{self.api_url}/api/v0/add?{query}",
+            data=self._multipart(files),
+            headers={"Content-Type":
+                     f"multipart/form-data; boundary={self.BOUNDARY}"},
+            method="POST")
+        with self.opener(req, timeout=self.timeout) as r:
+            lines = [json.loads(l) for l in r.read().splitlines() if l]
+        # the dir-wrap root is the entry with empty Name (ipfs.ts:42-47)
+        roots = [e["Hash"] for e in lines if e.get("Name", "") == ""]
+        if not roots or roots[-1] != b58encode(local_root):
+            raise PinMismatchError(
+                f"daemon root {roots[-1] if roots else None} != local "
+                f"{b58encode(local_root)}")
+        return local_root

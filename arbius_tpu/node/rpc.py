@@ -55,6 +55,8 @@ class ControlRPC:
                         "data": j.data} for j in jobs])
                 elif self.path == "/api/metrics":
                     self._send(200, outer.metrics())
+                elif self.path.startswith("/ipfs/"):
+                    outer.serve_ipfs(self)
                 else:
                     self._send(404, {"error": "not found"})
 
@@ -90,6 +92,53 @@ class ControlRPC:
         self.port = self.server.server_address[1]
         self._thread: threading.Thread | None = None
 
+    _CONTENT_TYPES = {".png": "image/png", ".jpg": "image/jpeg",
+                      ".mp4": "video/mp4", ".txt": "text/plain",
+                      ".json": "application/json"}
+
+    def serve_ipfs(self, handler) -> None:
+        """Gateway: /ipfs/<cid> (blob or dir listing), /ipfs/<cid>/<name>.
+
+        The data-availability half of the solve path: the CIDs the node
+        commits on-chain resolve to bytes here (the reference relies on
+        an external IPFS daemon/Pinata for this, ipfs.ts:28-114)."""
+        store = getattr(self.node, "store", None)
+        if store is None:
+            handler._send(404, {"error": "no content store configured"})
+            return
+        parts = [p for p in handler.path.split("/") if p][1:]  # drop 'ipfs'
+        try:
+            if len(parts) == 1:
+                data = store.get_file(parts[0])
+                if data is None:
+                    manifest = store.get_dir(parts[0])
+                    if manifest is None:
+                        handler._send(404, {"error": "cid not stored"})
+                    else:
+                        handler._send(200, {"cid": parts[0],
+                                            "files": manifest})
+                    return
+                name = ""
+            elif len(parts) == 2:
+                data = store.resolve(parts[0], parts[1])
+                if data is None:
+                    handler._send(404, {"error": "path not stored"})
+                    return
+                name = parts[1]
+            else:
+                handler._send(404, {"error": "bad ipfs path"})
+                return
+        except ValueError as e:
+            handler._send(400, {"error": str(e)})
+            return
+        ext = "." + name.rsplit(".", 1)[-1] if "." in name else ""
+        ctype = self._CONTENT_TYPES.get(ext, "application/octet-stream")
+        handler.send_response(200)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
     def recent_tasks(self, limit: int = 50) -> list[dict]:
         """Task/solution view — the explorer's data source (the reference
         website's explorer + task/[taskid] pages, `website/src/pages`)."""
@@ -107,12 +156,26 @@ class ControlRPC:
         dapp; the node serves an equivalent local view of tasks,
         solutions, and miner health with zero build tooling)."""
         m = self.metrics()
+
+        def cid_cell(cid_hex: str | None) -> str:
+            if not cid_hex:
+                return ""
+            try:
+                from arbius_tpu.node.store import cid_b58
+
+                b58 = cid_b58(cid_hex)
+            except ValueError:
+                return f"<code>{cid_hex[:20]}</code>"
+            if getattr(self.node, "store", None) and self.node.store.has(b58):
+                return f"<a href='/ipfs/{b58}'><code>{b58[:16]}…</code></a>"
+            return f"<code>{b58[:16]}…</code>"
+
         rows = "".join(
             f"<tr><td><code>{t['taskid'][:18]}…</code></td>"
             f"<td><code>{(t['model'] or '')[:14]}…</code></td>"
             f"<td>{t['fee']}</td>"
             f"<td>{'invalid' if t['invalid'] else ('claimed' if t['claimed'] else ('solved' if t['solution_validator'] else 'pending'))}</td>"
-            f"<td><code>{(t['solution_cid'] or '')[:20]}</code></td></tr>"
+            f"<td>{cid_cell(t['solution_cid'])}</td></tr>"
             for t in self.recent_tasks())
         stats = "".join(f"<li>{k}: <b>{v}</b></li>" for k, v in m.items())
         return (
@@ -138,6 +201,8 @@ class ControlRPC:
             "solutions_claimed": m.solutions_claimed,
             "contestations_submitted": m.contestations_submitted,
             "votes_cast": m.votes_cast,
+            "vote_finishes": m.vote_finishes,
+            "tasks_unprofitable": m.tasks_unprofitable,
             "queue_depth": self.node.db.job_count(),
             "solve_latency_p50": _p50(lat),
             "solve_latency_p95": float(np.percentile(lat, 95)) if lat else None,

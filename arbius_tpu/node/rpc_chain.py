@@ -200,6 +200,10 @@ class RpcChain:
     def min_claim_solution_time(self) -> int:
         return self._view("minClaimSolutionTime()", [], [], ["uint256"])[0]
 
+    def min_contestation_vote_period(self) -> int:
+        return self._view("minContestationVotePeriodTime()", [], [],
+                          ["uint256"])[0]
+
     def token_balance(self) -> int:
         try:
             raw = self.client.eth_call_to(

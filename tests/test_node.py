@@ -42,7 +42,7 @@ def fake_runner(hydrated: dict, seed: int) -> dict:
 
 
 def build_world(*, evilmode=False, automine=None, miner_stake=100 * WAD,
-                model_fee=0):
+                model_fee=0, **cfg_overrides):
     tok = TokenLedger()
     eng = Engine(tok, start_time=10_000)
     tok.mint(Engine.ADDRESS, 600_000 * WAD)
@@ -62,7 +62,8 @@ def build_world(*, evilmode=False, automine=None, miner_stake=100 * WAD,
         chain.validator_deposit(miner_stake)
     cfg = MiningConfig(evilmode=evilmode,
                        models=(ModelConfig(id=mid, template="anythingv3"),),
-                       automine=automine or AutomineConfig())
+                       automine=automine or AutomineConfig(),
+                       **cfg_overrides)
     node = MinerNode(chain, cfg, registry)
     node.boot()
     drain(node)  # settle the boot-queued stake job (re-queues at +600s)
