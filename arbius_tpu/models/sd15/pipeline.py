@@ -75,17 +75,29 @@ class SD15Pipeline:
 
     # -- params ----------------------------------------------------------
     def init_params(self, seed: int = 0, height: int = 64, width: int = 64) -> dict:
-        """Deterministic parameter init (stands in for converted weights)."""
-        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        """Deterministic parameter init (stands in for converted weights).
+
+        The whole init is one jitted XLA program so parameters materialize
+        directly on the accelerator: eager flax `.init` dispatches hundreds
+        of small ops one-by-one, which is pathological over a remote-TPU
+        tunnel (each dispatch is a round-trip), and host-side init would
+        need a multi-GB host→HBM transfer afterwards. Same bits either way
+        (JAX PRNG is algorithmically deterministic under jit)."""
         lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
-        latents = jnp.zeros((1, lh, lw, self.config.unet.in_channels))
-        ids = jnp.zeros((1, self.config.text.max_length), jnp.int32)
-        ctx = jnp.zeros((1, self.config.text.max_length, self.config.unet.context_dim))
-        return {
-            "unet": self.unet.init(k1, latents, jnp.zeros((1,)), ctx)["params"],
-            "vae": self.vae.init(k2, latents)["params"],
-            "text": self.text_encoder.init(k3, ids)["params"],
-        }
+
+        def _init(key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            latents = jnp.zeros((1, lh, lw, self.config.unet.in_channels))
+            ids = jnp.zeros((1, self.config.text.max_length), jnp.int32)
+            ctx = jnp.zeros(
+                (1, self.config.text.max_length, self.config.unet.context_dim))
+            return {
+                "unet": self.unet.init(k1, latents, jnp.zeros((1,)), ctx)["params"],
+                "vae": self.vae.init(k2, latents)["params"],
+                "text": self.text_encoder.init(k3, ids)["params"],
+            }
+
+        return jax.jit(_init)(jax.random.PRNGKey(seed))
 
     def place_params(self, params: dict, tp_rules=None) -> dict:
         """Shard params onto self.mesh: TP kernels by rule (the family's

@@ -1,44 +1,59 @@
 """Benchmark: solutions/hour/chip on the anythingv3 task shape.
 
-Runs the flagship SD-1.5 solve step (full production topology: ViT-L text
-tower, 860M-param-class UNet2DCondition, VAE decoder) at the BASELINE.md
-metric config — 512×512, 20 denoise steps, DPMSolverMultistep, CFG — and
-reports steady-state throughput as solutions/hour on the local device(s).
+Metric config (BASELINE.md): SD-1.5 at 512×512, 20 denoise steps,
+DPMSolverMultistep, CFG — the anythingv3 queue's shape. Weights are
+deterministically random (init_params); FLOPs and memory traffic are
+identical to converted weights, so throughput is representative.
 
-The reference publishes no benchmark numbers (BASELINE.md: `published:{}`);
-`vs_baseline` is measured against the documented anchor of a single-A100
-cog miner on the same task shape, ~0.5 solutions/s end-to-end inference
-(≈1800 solutions/hour) — the hardware class the reference requires
-(docs/src/pages/mining.mdx:7-19). Weights are deterministically random
-(init_params); FLOPs and memory traffic are identical to converted weights,
-so throughput is representative.
+Structure — an escalation ladder that cannot print nothing (rounds 1-2
+both timed out with zero output; the round-2 postmortem: eager 860M-param
+init dispatched op-by-op over the remote-TPU tunnel, inside a monolithic
+all-or-nothing script):
 
-Robustness (the round-1 bench timed out with zero output): a subprocess
-probe checks the remote-TPU tunnel first — backend init has been observed
-to hang >15 min when the tunnel is unhealthy. If the probe fails, the
-bench falls back to a reduced CPU-only config and STILL prints its JSON
-line, flagged `"note": "tpu_unreachable_cpu_fallback"` with
-`vs_baseline: 0` (no perf claim). Progress goes to stderr so a timeout
-still yields diagnostics. A persistent XLA compile cache under
-`.jax_cache_bench/` makes re-runs skip the multi-minute jit.
+  stage tiny     tiny topology, 128×128×4 — proves the TPU executes
+                 end-to-end in ~a minute; no perf claim (vs_baseline 0).
+  stage prod     full production topology at 512×512. Emits TWO lines:
+                 first a measured-4-step run extrapolated to 20 steps
+                 (clearly labeled; conservative — fixed text/VAE overhead
+                 is counted 5×), then the real 20-step measurement.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Each stage runs in its own time-boxed subprocess; the child appends one
+JSON object per result line to a scratch file, and the parent streams
+every completed line to stdout the moment it appears — so a driver kill
+at ANY point still leaves the best-so-far number printed. Children
+heartbeat their current phase to stderr every 15 s, so a timeout shows
+*where* it died (init? compile? execute?). Param init runs as one jitted
+on-device program (see SD15Pipeline.init_params).
+
+If the TPU tunnel probe fails, the tiny stage runs on CPU and the line is
+flagged `tpu_unreachable_cpu_fallback` with vs_baseline 0 (no perf claim).
+
+The last line printed is the final result:
+{"metric", "value", "unit", "vs_baseline", ...}.
+
+`vs_baseline` is measured against ~1800 solutions/hour for the single-A100
+cog miner the reference requires (docs/src/pages/mining.mdx:7-19). That
+anchor is this repo's ESTIMATE (~2 s/solution end-to-end at 512×512×20);
+the reference itself publishes no numbers (BASELINE.md: `published:{}`).
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
-A100_SOLUTIONS_PER_HOUR = 1800.0  # documented anchor, see module docstring
+A100_SOLUTIONS_PER_HOUR_EST = 1800.0  # builder's estimate — see docstring
 
 WIDTH = HEIGHT = 512
 STEPS = 20
 SCHEDULER = "DPMSolverMultistep"
-ROUNDS = 2
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "300"))
+TINY_TIMEOUT_S = int(os.environ.get("BENCH_TINY_TIMEOUT_S", "600"))
+PROD_TIMEOUT_S = int(os.environ.get("BENCH_PROD_TIMEOUT_S", "2400"))
 
 _T0 = time.perf_counter()
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -49,12 +64,12 @@ def _note(msg: str) -> None:
           file=sys.stderr, flush=True)
 
 
-def _tpu_reachable() -> tuple[bool, str]:
-    """Probe backend init in a subprocess so a tunnel hang can't eat the bench.
+# ---------------------------------------------------------------------------
+# parent: probe, ladder, line streaming
+# ---------------------------------------------------------------------------
 
-    Returns (ok, reason) where reason distinguishes a deliberate CPU run
-    (`cpu_forced`) from a dead tunnel (`tpu_unreachable_cpu_fallback`).
-    """
+def _tpu_reachable() -> tuple[bool, str]:
+    """Probe backend init in a subprocess so a tunnel hang can't eat the bench."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         _note("JAX_PLATFORMS=cpu set — deliberate CPU run, skipping probe")
         return False, "cpu_forced"
@@ -74,90 +89,255 @@ def _tpu_reachable() -> tuple[bool, str]:
     return ok, "ok" if ok else "tpu_unreachable_cpu_fallback"
 
 
-def _run(pipe, params, batch: int, *, width: int, height: int, steps: int,
-         rounds: int) -> tuple[float, object]:
-    kw = dict(width=width, height=height, num_inference_steps=steps,
-              scheduler=SCHEDULER, guidance_scale=12.0)
-    prompts = [f"arbius bench task {i}" for i in range(batch)]
-    negs = [""] * batch
-    _note(f"compiling + warmup: batch={batch} {width}x{height} steps={steps}")
-    pipe.generate(params, prompts, negs, list(range(batch)), **kw)
-    _note("warmup done; timing")
-    t0 = time.perf_counter()
-    out = None
-    for r in range(rounds):
-        out = pipe.generate(params, prompts, negs,
-                            [r * batch + i for i in range(batch)], **kw)
-        _note(f"round {r + 1}/{rounds} done")
-    return time.perf_counter() - t0, out
+def _stream_stage(stage: str, timeout_s: int, extra_env: dict | None = None) -> int:
+    """Run a stage child; stream each completed JSON line from its scratch
+    file to stdout as it appears. Returns the number of lines emitted."""
+    out_path = os.path.join(_REPO, f".bench_{stage}.jsonl")
+    try:
+        os.unlink(out_path)
+    except FileNotFoundError:
+        pass
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    _note(f"stage {stage}: starting (timeout {timeout_s}s)")
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--stage", stage,
+         "--out", out_path],
+        stdout=subprocess.DEVNULL, stderr=None, env=env)  # stderr passes through
+    deadline = time.perf_counter() + timeout_s
+    emitted = 0
+
+    def drain() -> int:
+        nonlocal emitted
+        if not os.path.exists(out_path):
+            return emitted
+        with open(out_path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        for ln in lines[emitted:]:
+            try:
+                json.loads(ln)
+            except ValueError:
+                continue  # partially-written line; next drain gets it
+            print(ln, flush=True)
+            emitted += 1
+        return emitted
+
+    while child.poll() is None and time.perf_counter() < deadline:
+        drain()
+        time.sleep(1.0)
+    if child.poll() is None:
+        _note(f"stage {stage}: TIMED OUT after {timeout_s}s — killing")
+        child.kill()
+        child.wait()
+    else:
+        _note(f"stage {stage}: exited rc={child.returncode}")
+    drain()
+    return emitted
 
 
 def main() -> None:
     on_tpu, reason = _tpu_reachable()
+    total = 0
     if not on_tpu:
-        # Never let in-process backend discovery dial the dead tunnel.
+        total += _stream_stage(
+            "tiny", TINY_TIMEOUT_S, {"BENCH_FALLBACK_NOTE": reason})
+    else:
+        # A stale exported BENCH_FALLBACK_NOTE would silently force the
+        # tiny child onto CPU despite a healthy TPU.
+        os.environ.pop("BENCH_FALLBACK_NOTE", None)
+        total += _stream_stage("tiny", TINY_TIMEOUT_S)
+        prod_timeout = PROD_TIMEOUT_S
+        if total == 0:
+            # Tunnel died after the probe (the round-1/2 failure mode).
+            # Print the backstop NOW so any later kill still leaves a
+            # line, and give prod one short-budget attempt only.
+            _emit_backstop("tiny_stage_failed_post_probe")
+            total += 1
+            prod_timeout = min(prod_timeout, TINY_TIMEOUT_S)
+        total += _stream_stage("prod", prod_timeout)
+    if total == 0:
+        _emit_backstop("all_stages_failed")
+    _note(f"done: {total} result line(s)")
+
+
+def _emit_backstop(note: str) -> None:
+    print(json.dumps({
+        "metric": "anythingv3_solutions_per_hour_per_chip",
+        "value": 0.0,
+        "unit": f"solutions/hour/chip (BENCH STAGE FAILURE: {note} — see stderr)",
+        "vs_baseline": 0.0,
+        "note": note,
+    }), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# children: actual measurement
+# ---------------------------------------------------------------------------
+
+class _Heartbeat:
+    """Background thread printing the current phase every 15 s to stderr."""
+
+    def __init__(self, stage: str):
+        self.stage = stage
+        self.phase = "start"
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def set(self, phase: str) -> None:
+        self.phase = phase
+        _note(f"[{self.stage}] phase: {phase}")
+
+    def _run(self) -> None:
+        while not self._stop.wait(15.0):
+            _note(f"[{self.stage}] heartbeat: phase={self.phase}")
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _emit(out_path: str, line: dict) -> None:
+    with open(out_path, "a") as f:
+        f.write(json.dumps(line) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    _note(f"result: {json.dumps(line)}")
+
+
+def _timed_solutions(pipe, params, batch: int, *, width: int, height: int,
+                     steps: int, rounds: int, hb: _Heartbeat) -> float:
+    """Compile + warm up one bucket, then time `rounds` runs.
+    Returns seconds per solution."""
+    import numpy as np
+
+    kw = dict(width=width, height=height, num_inference_steps=steps,
+              scheduler=SCHEDULER, guidance_scale=12.0)
+    prompts = [f"arbius bench task {i}" for i in range(batch)]
+    negs = [""] * batch
+    hb.set(f"compile+warmup {width}x{height} steps={steps} batch={batch}")
+    out = pipe.generate(params, prompts, negs, list(range(batch)), **kw)
+    assert out.shape == (batch, height, width, 3) and out.dtype == np.uint8
+    hb.set(f"timing {rounds} round(s) of {width}x{height} steps={steps}")
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        pipe.generate(params, prompts, negs,
+                      [(r + 1) * batch + i for i in range(batch)], **kw)
+        _note(f"round {r + 1}/{rounds} done")
+    return (time.perf_counter() - t0) / (rounds * batch)
+
+
+def _child_common(cpu: bool):
+    # env JAX_PLATFORMS=cpu is NOT enough here: the deployment's axon
+    # register module monkeypatches get_backend and dials the remote-TPU
+    # tunnel anyway; force_cpu_devices neuters the non-CPU factories.
+    if cpu:
         from arbius_tpu.utils import force_cpu_devices
 
         force_cpu_devices(1)
-
     import jax
-    import numpy as np
 
-    from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
     from arbius_tpu.utils import enable_compile_cache
 
     enable_compile_cache(os.path.join(_REPO, ".jax_cache_bench"))
+    devs = jax.devices()
+    _note(f"platform={devs[0].platform} n_dev={len(devs)}")
+    return devs
 
-    n_dev = len(jax.devices())
-    batch = max(1, n_dev)  # one task per chip — the dp unit of the miner
-    mesh = None
-    if n_dev > 1:
-        from arbius_tpu.parallel import MeshSpec, build_mesh
 
-        mesh = build_mesh(MeshSpec(dp=n_dev))
-    _note(f"platform={jax.devices()[0].platform} n_dev={n_dev}")
+def _stage_tiny(out_path: str) -> None:
+    """Tiny topology end-to-end — a number in about a minute, no perf claim."""
+    hb = _Heartbeat("tiny")
+    devs = _child_common(cpu=bool(os.environ.get("BENCH_FALLBACK_NOTE")))
+    platform = devs[0].platform
 
-    if on_tpu:
-        width, height, steps = WIDTH, HEIGHT, STEPS
-        cfg = SD15Config()  # full production topology
-    else:
-        # Documented reduced CPU fallback: full pipeline structure at tiny
-        # width so the line still prints on a 1-core host. No perf claim.
-        width, height, steps = 128, 128, 4
-        cfg = SD15Config.tiny()
+    from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline
+    from arbius_tpu.node.factory import tiny_byte_tokenizer
 
-    if on_tpu:
-        tok = ByteTokenizer()
-    else:
-        from arbius_tpu.node.factory import tiny_byte_tokenizer
+    cfg = SD15Config.tiny()
+    pipe = SD15Pipeline(cfg, tokenizer=tiny_byte_tokenizer(cfg.text))
+    hb.set("init_params (tiny)")
+    params = pipe.init_params(seed=0, height=128, width=128)
+    sec = _timed_solutions(pipe, params, 1, width=128, height=128, steps=4,
+                           rounds=2, hb=hb)
+    note = os.environ.get("BENCH_FALLBACK_NOTE", "stage_tiny_sanity")
+    _emit(out_path, {
+        "metric": "anythingv3_solutions_per_hour_per_chip",
+        "value": round(3600.0 / sec, 2),
+        "unit": (f"solutions/hour/chip (TINY topology 128x128, 4 steps, "
+                 f"platform={platform} — sanity stage, no perf claim)"),
+        "vs_baseline": 0.0,
+        "note": note,
+        "stage": "tiny",
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+    })
+    hb.stop()
 
-        tok = tiny_byte_tokenizer(cfg.text)
-    pipe = SD15Pipeline(cfg, mesh=mesh, tokenizer=tok)
-    params = pipe.place_params(pipe.init_params(seed=0,
-                                                height=height, width=width))
-    dt, out = _run(pipe, params, batch, width=width, height=height,
-                   steps=steps, rounds=ROUNDS)
-    assert out.shape == (batch, height, width, 3) and out.dtype == np.uint8
 
-    per_chip = (ROUNDS * batch / dt) * 3600.0 / n_dev
-    if on_tpu:
-        line = {
-            "metric": "anythingv3_solutions_per_hour_per_chip",
-            "value": round(per_chip, 2),
-            "unit": "solutions/hour/chip (SD-1.5 512x512, 20 steps, DPM++)",
-            "vs_baseline": round(per_chip / A100_SOLUTIONS_PER_HOUR, 3),
-        }
-    else:
-        line = {
-            "metric": "anythingv3_solutions_per_hour_per_chip",
-            "value": round(per_chip, 2),
-            "unit": (f"solutions/hour/chip (CPU FALLBACK: tiny config "
-                     f"{width}x{height}, {steps} steps — no TPU perf claim)"),
-            "vs_baseline": 0.0,
-            "note": reason,
-        }
-    print(json.dumps(line))
+def _stage_prod(out_path: str) -> None:
+    """Full production topology at 512×512: extrapolated line, then real."""
+    hb = _Heartbeat("prod")
+    _child_common(cpu=False)
+
+    from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
+
+    pipe = SD15Pipeline(SD15Config(), tokenizer=ByteTokenizer())
+    hb.set("init_params (full 860M-class, jitted on-device)")
+    t_init = time.perf_counter()
+    params = pipe.init_params(seed=0, height=HEIGHT, width=WIDTH)
+    import jax
+
+    jax.block_until_ready(params)
+    _note(f"init_params done in {time.perf_counter() - t_init:.1f}s")
+
+    # line 1: measured 4-step, extrapolated to the 20-step metric shape.
+    # Conservative: scaling t4 by 20/4 re-counts the fixed text-encoder +
+    # VAE + dispatch overhead 5x, so the true 20-step throughput is higher.
+    sec4 = _timed_solutions(pipe, params, 1, width=WIDTH, height=HEIGHT,
+                            steps=4, rounds=2, hb=hb)
+    est = 3600.0 / (sec4 * (STEPS / 4))
+    _emit(out_path, {
+        "metric": "anythingv3_solutions_per_hour_per_chip",
+        "value": round(est, 2),
+        "unit": (f"solutions/hour/chip (SD-1.5 512x512 FULL topology, "
+                 f"EXTRAPOLATED 20-step from measured 4-step x5, {SCHEDULER})"),
+        "vs_baseline": round(est / A100_SOLUTIONS_PER_HOUR_EST, 3),
+        "baseline_note": "anchor 1800 sol/h/A100 is this repo's estimate; "
+                         "reference publishes no numbers",
+        "note": "stage_prod_extrapolated",
+        "stage": "prod4",
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+    })
+
+    # line 2: the real metric — 20 steps measured.
+    sec20 = _timed_solutions(pipe, params, 1, width=WIDTH, height=HEIGHT,
+                             steps=STEPS, rounds=2, hb=hb)
+    val = 3600.0 / sec20
+    _emit(out_path, {
+        "metric": "anythingv3_solutions_per_hour_per_chip",
+        "value": round(val, 2),
+        "unit": (f"solutions/hour/chip (SD-1.5 512x512, {STEPS} steps, "
+                 f"{SCHEDULER}, CFG — measured on real TPU)"),
+        "vs_baseline": round(val / A100_SOLUTIONS_PER_HOUR_EST, 3),
+        "baseline_note": "anchor 1800 sol/h/A100 is this repo's estimate; "
+                         "reference publishes no numbers",
+        "note": "stage_prod_measured",
+        "stage": "prod20",
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+    })
+    hb.stop()
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", choices=["tiny", "prod"])
+    ap.add_argument("--out")
+    ns = ap.parse_args()
+    if ns.stage is not None and not ns.out:
+        ns.out = os.path.join(_REPO, f".bench_{ns.stage}.jsonl")
+    if ns.stage is None:
+        main()
+    elif ns.stage == "tiny":
+        _stage_tiny(ns.out)
+    else:
+        _stage_prod(ns.out)
