@@ -101,6 +101,15 @@ def cmd_emission(args) -> int:
 
 
 def cmd_demo_mine(args) -> int:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # honor a deliberate CPU run: the deployment's axon plugin
+        # monkeypatches backend lookup and would dial the remote-TPU
+        # tunnel regardless of the env var (hanging when it's unhealthy)
+        from arbius_tpu.utils import force_cpu_devices
+
+        force_cpu_devices(1)
     from arbius_tpu.chain import Engine, TokenLedger, WAD
     from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
     from arbius_tpu.node import (

@@ -64,19 +64,51 @@ class TimestepEmbedding(nn.Module):
 
 
 class ResnetBlock(nn.Module):
-    """GN-SiLU-conv ×2 with timestep conditioning and learned skip."""
+    """GN-SiLU-conv ×2 with timestep conditioning and learned skip.
+
+    `scale_shift=True` switches the timestep injection to the FiLM-style
+    scale/shift form some published UNets use (time_emb_proj predicts
+    [scale, shift] pairs applied after the second GroupNorm) — parameter
+    shapes differ (2× projection width), so the flag is part of the
+    checkpoint topology, not a numerics toggle.
+
+    `resample` ("none"|"down"|"up") folds the unCLIP-family resnet-based
+    down/upsampling into the block (parameter-free 2× average-pool /
+    nearest-upsample applied to BOTH branches between the first norm and
+    conv) — the published "ResnetDownsample/Upsample" and "Simple" block
+    samplers are resnets of exactly this shape.
+    """
     out_channels: int
     dtype: jnp.dtype = jnp.bfloat16
+    scale_shift: bool = False
+    resample: str = "none"
+
+    def _resample(self, x):
+        if self.resample == "down":
+            return nn.avg_pool(x, (2, 2), strides=(2, 2))
+        if self.resample == "up":
+            b, h, w, c = x.shape
+            return jax.image.resize(x, (b, h * 2, w * 2, c), method="nearest")
+        return x
 
     @nn.compact
     def __call__(self, x, temb=None):
         h = GroupNorm32()(x)
         h = nn.silu(h)
+        if self.resample != "none":
+            h = self._resample(h)
+            x = self._resample(x)
         h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype)(h)
+        t = None
         if temb is not None:
-            t = nn.Dense(self.out_channels, dtype=self.dtype)(nn.silu(temb))
-            h = h + t[:, None, None, :]
+            width = self.out_channels * (2 if self.scale_shift else 1)
+            t = nn.Dense(width, dtype=self.dtype)(nn.silu(temb))
+            if not self.scale_shift:
+                h = h + t[:, None, None, :]
         h = GroupNorm32()(h)
+        if t is not None and self.scale_shift:
+            scale, shift = jnp.split(t[:, None, None, :], 2, axis=-1)
+            h = h * (1 + scale) + shift
         h = nn.silu(h)
         h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype)(h)
         if x.shape[-1] != self.out_channels:

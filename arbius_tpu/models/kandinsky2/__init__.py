@@ -3,6 +3,12 @@
 The reference's flagship/boot-self-test model class
 (`templates/kandinsky2.json`, `miner/src/index.ts:844-877`).
 """
+from arbius_tpu.models.kandinsky2.convert import (
+    convert_kandinsky2_decoder,
+    convert_kandinsky2_movq,
+    convert_kandinsky2_prior,
+    convert_kandinsky2_text_projection,
+)
 from arbius_tpu.models.kandinsky2.decoder import DecoderConfig, DecoderUNet
 from arbius_tpu.models.kandinsky2.movq import MOVQConfig, MOVQDecoder
 from arbius_tpu.models.kandinsky2.pipeline import (
@@ -18,5 +24,7 @@ from arbius_tpu.models.kandinsky2.prior import (
 __all__ = [
     "DecoderConfig", "DecoderUNet", "Kandinsky2Config", "Kandinsky2Pipeline",
     "MOVQConfig", "MOVQDecoder", "PriorConfig", "PriorTransformer",
+    "convert_kandinsky2_decoder", "convert_kandinsky2_movq",
+    "convert_kandinsky2_prior", "convert_kandinsky2_text_projection",
     "prior_sample",
 ]

@@ -6,6 +6,15 @@ VQGAN-style decoder whose distinguishing feature is *spatially modulated*
 group norm: normalization parameters are conv-predicted from the quantized
 latent, re-injecting spatial detail at every scale.
 
+Topology mirrors the published diffusers-format VQModel decoder
+(norm_type="spatial") so converted weights drive it 1:1
+(kandinsky2/convert.py): post_quant conv → conv_in → mid
+(res, spatially-normed attention, res) → up tower with
+`layers_per_block + 1` resnets per level (the published VQ decoder's
+count) → spatial norm_out → conv_out. The Kandinsky latent path decodes
+CONTINUOUS latents (the published pipeline's force_not_quantize), so no
+codebook lookup exists here.
+
 TPU notes: NHWC convs in bf16, norms in f32 (same policy as models/common);
 attention at the lowest resolution only, so the op mix is conv-dominated —
 pure MXU work with no dynamic shapes.
@@ -25,7 +34,7 @@ from arbius_tpu.models.common import Attention, GroupNorm32, Upsample
 class MOVQConfig:
     latent_channels: int = 4
     block_channels: tuple[int, ...] = (128, 256, 256, 512)  # low→high res order
-    layers_per_block: int = 2
+    layers_per_block: int = 2     # published decoder runs this + 1 resnets
     dtype: str = "bfloat16"
 
     @property
@@ -48,7 +57,7 @@ class SpatialNorm(nn.Module):
         normed = GroupNorm32(name="norm")(h)
         scale = nn.Conv(c, (1, 1), dtype=self.dtype, name="conv_y")(z_up)
         shift = nn.Conv(c, (1, 1), dtype=self.dtype, name="conv_b")(z_up)
-        return normed * (1 + scale.astype(normed.dtype)) + shift.astype(normed.dtype)
+        return normed * scale.astype(normed.dtype) + shift.astype(normed.dtype)
 
 
 class MOVQResBlock(nn.Module):
@@ -78,20 +87,25 @@ class MOVQDecoder(nn.Module):
         cfg = self.config
         dt = cfg.jdtype
         z = z.astype(dt)
+        # spatial norms condition on the RAW latent; the post-quant conv
+        # feeds only the conv tower (published decode(quant) semantics)
+        zin = nn.Conv(cfg.latent_channels, (1, 1), dtype=dt,
+                      name="post_quant")(z)
         chans = cfg.block_channels
-        h = nn.Conv(chans[-1], (3, 3), padding=1, dtype=dt, name="conv_in")(z)
+        h = nn.Conv(chans[-1], (3, 3), padding=1, dtype=dt, name="conv_in")(zin)
 
         # mid: res + attention + res at the lowest resolution
         h = MOVQResBlock(chans[-1], dt, name="mid_res_0")(h, z)
         b, hh, ww, c = h.shape
         attn_in = SpatialNorm(dt, name="mid_attn_norm")(h, z).reshape(b, hh * ww, c)
-        h = h + Attention(num_heads=1, head_dim=c, dtype=dt, name="mid_attn")(
-            attn_in).reshape(b, hh, ww, c)
+        h = h + Attention(num_heads=1, head_dim=c, dtype=dt, qkv_bias=True,
+                          name="mid_attn")(attn_in).reshape(b, hh, ww, c)
         h = MOVQResBlock(chans[-1], dt, name="mid_res_1")(h, z)
 
-        # upsampling tower: 3 doublings (×8 total like the VAE factor)
+        # upsampling tower: 3 doublings (×8 total like the VAE factor);
+        # layers_per_block + 1 resnets per level, the published count
         for level in reversed(range(len(chans))):
-            for j in range(cfg.layers_per_block):
+            for j in range(cfg.layers_per_block + 1):
                 h = MOVQResBlock(chans[level], dt,
                                  name=f"up_{level}_res_{j}")(h, z)
             if level > 0:

@@ -6,14 +6,28 @@ jitted XLA program per shape bucket. Same determinism contract as SD-1.5:
 the per-task seed keys every stochastic draw via fold_in, buckets run at a
 canonical batch, so output bytes depend only on (model build, input, seed).
 
+Stage wiring follows the published two-pipeline graph so converted
+checkpoints drive it 1:1 (kandinsky2/convert.py):
+
+  text tower (+ projection)  → hidden states, EOT-pooled projected embed
+  prior                      → CLIP-image embedding (normalized space;
+                               de-normalized via the checkpoint's
+                               clip_mean/clip_std stats)
+  decoder UNet               → epsilon (the learned-variance half of the
+                               8-channel output is discarded — samplers
+                               here are deterministic)
+  MOVQ                       → pixels
+
 Template parity (`templates/kandinsky2.json`): prompt, negative_prompt
 (unused by the prior's CFG-zero branch but accepted), w/h ∈ {768, 1024},
 num_inference_steps, guidance_scale, seed; output out-1.png.
 """
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -24,6 +38,7 @@ from arbius_tpu.models.kandinsky2.prior import (
     PriorConfig,
     PriorTransformer,
     prior_sample,
+    prior_stats_init,
 )
 from arbius_tpu.models.sd15.text_encoder import TextEncoder, TextEncoderConfig
 from arbius_tpu.models.sd15.tokenizer import ByteTokenizer
@@ -33,17 +48,34 @@ from arbius_tpu.schedulers import get_sampler
 
 @dataclass(frozen=True)
 class Kandinsky2Config:
+    # defaults are the published checkpoint shapes: open_clip bigG text
+    # tower (1280-wide, plain gelu) + 1280-dim image embedding space
     prior: PriorConfig = PriorConfig()
     decoder: DecoderConfig = DecoderConfig()
     movq: MOVQConfig = MOVQConfig()
-    text: TextEncoderConfig = TextEncoderConfig()
+    text: TextEncoderConfig = TextEncoderConfig(width=1280, layers=32,
+                                                heads=20, act="gelu")
     prior_steps: int = 25
 
     @classmethod
     def tiny(cls) -> "Kandinsky2Config":
-        return cls(prior=PriorConfig.tiny(), decoder=DecoderConfig.tiny(),
+        dec = DecoderConfig.tiny()
+        # exercise the learned-variance slice even at toy size
+        dec = dataclasses.replace(
+            dec, unet=dataclasses.replace(dec.unet, out_channels=8))
+        return cls(prior=PriorConfig.tiny(), decoder=dec,
                    movq=MOVQConfig.tiny(), text=TextEncoderConfig.tiny(),
                    prior_steps=2)
+
+
+class TextProjection(nn.Module):
+    """CLIP text_projection: EOT-pooled hidden state → embedding space."""
+    dim: int
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.dim, use_bias=False, dtype=jnp.float32,
+                        name="proj")(x)
 
 
 class Kandinsky2Pipeline:
@@ -55,11 +87,6 @@ class Kandinsky2Pipeline:
                  mesh=None):
         self.config = config or Kandinsky2Config()
         self.mesh = mesh
-        if self.config.text.width != self.config.prior.clip_dim:
-            raise ValueError(
-                f"text width ({self.config.text.width}) must equal prior "
-                f"clip_dim ({self.config.prior.clip_dim}) — the prior "
-                "consumes raw text-encoder states")
         if self.config.text.max_length < self.config.prior.text_len:
             raise ValueError(
                 f"text max_length ({self.config.text.max_length}) must be "
@@ -67,6 +94,7 @@ class Kandinsky2Pipeline:
         self.tokenizer = tokenizer or ByteTokenizer(
             max_length=self.config.text.max_length)
         self.text_encoder = TextEncoder(self.config.text)
+        self.text_projection = TextProjection(self.config.prior.clip_dim)
         self.prior = PriorTransformer(self.config.prior)
         self.decoder = DecoderUNet(self.config.decoder)
         self.movq = MOVQDecoder(self.config.movq)
@@ -74,22 +102,29 @@ class Kandinsky2Pipeline:
 
     # -- params ----------------------------------------------------------
     def init_params(self, seed: int = 0, height: int = 64, width: int = 64) -> dict:
-        k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(seed), 4)
         cfg = self.config
         lh, lw = height // self.MOVQ_FACTOR, width // self.MOVQ_FACTOR
-        ids = jnp.zeros((1, cfg.text.max_length), jnp.int32)
-        tok = jnp.zeros((1, cfg.prior.text_len, cfg.prior.clip_dim))
-        pooled = jnp.zeros((1, cfg.prior.clip_dim))
-        embed = jnp.zeros((1, cfg.prior.clip_dim))
-        lat = jnp.zeros((1, lh, lw, cfg.decoder.unet.in_channels))
-        return {
-            "text": self.text_encoder.init(k1, ids)["params"],
-            "prior": self.prior.init(k2, embed, jnp.zeros((1,)), tok,
-                                     pooled)["params"],
-            "decoder": self.decoder.init(k3, lat, jnp.zeros((1,)),
-                                         embed)["params"],
-            "movq": self.movq.init(k4, lat)["params"],
-        }
+
+        def _init(key):
+            k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+            ids = jnp.zeros((1, cfg.text.max_length), jnp.int32)
+            tok = jnp.zeros((1, cfg.prior.text_len, cfg.text.width))
+            pooled = jnp.zeros((1, cfg.prior.clip_dim))
+            embed = jnp.zeros((1, cfg.prior.clip_dim))
+            lat = jnp.zeros((1, lh, lw, cfg.decoder.unet.in_channels))
+            return {
+                "text": self.text_encoder.init(k1, ids)["params"],
+                "text_proj": self.text_projection.init(
+                    k5, jnp.zeros((1, cfg.text.width)))["params"],
+                "prior": self.prior.init(k2, embed, jnp.zeros((1,)), tok,
+                                         pooled)["params"],
+                "prior_stats": prior_stats_init(None, (2, cfg.prior.clip_dim)),
+                "decoder": self.decoder.init(k3, lat, jnp.zeros((1,)),
+                                             embed)["params"],
+                "movq": self.movq.init(k4, lat)["params"],
+            }
+
+        return jax.jit(_init)(jax.random.PRNGKey(seed))
 
     def place_params(self, params: dict, tp_rules=None) -> dict:
         if self.mesh is None:
@@ -117,21 +152,33 @@ class Kandinsky2Pipeline:
         cfg = self.config
         sampler = get_sampler(scheduler, steps)
         lh, lw = height // self.MOVQ_FACTOR, width // self.MOVQ_FACTOR
-        lat_shape = (batch, lh, lw, cfg.decoder.unet.in_channels)
+        in_ch = cfg.decoder.unet.in_channels
+        lat_shape = (batch, lh, lw, in_ch)
         text_len = cfg.prior.text_len
+        eos_id = self.tokenizer.eos_id
 
         def run(params, ids, guidance, seeds_lo, seeds_hi):
             states = self.text_encoder.apply({"params": params["text"]}, ids)
-            # prior consumes a fixed text_len window + pooled (last token)
+            # EOT pooling: hidden state at the first EOS position, then the
+            # projection into embedding space (CLIP *WithProjection heads)
+            first_eos = jnp.argmax((ids == eos_id).astype(jnp.int32), axis=1)
+            pooled_pre = states[jnp.arange(states.shape[0]), first_eos]
+            pooled = self.text_projection.apply(
+                {"params": params["text_proj"]}, pooled_pre)
+            # attention mask: real tokens up to and including the EOT
+            positions = jnp.arange(ids.shape[1])[None, :]
+            mask = (positions <= first_eos[:, None]).astype(jnp.float32)
+
             tok = states[:, :text_len]
-            pooled = states[:, -1]
             keys = jax.vmap(
                 lambda lo, hi: jax.random.fold_in(jax.random.PRNGKey(lo), hi)
             )(seeds_lo, seeds_hi)
             g = guidance.astype(jnp.float32)
 
             embed = prior_sample(self.prior, params["prior"], tok, pooled,
-                                 keys, g, steps=cfg.prior_steps)
+                                 keys, g, steps=cfg.prior_steps,
+                                 text_mask=mask[:, :text_len],
+                                 clip_stats=params["prior_stats"])
 
             x = jax.vmap(lambda k: jax.random.normal(
                 k, lat_shape[1:], jnp.float32))(keys)
@@ -144,9 +191,12 @@ class Kandinsky2Pipeline:
                 xin = jnp.concatenate([x, x], axis=0) * sampler.input_scale[i]
                 t = jnp.full((2 * batch,), sampler.timesteps[i])
                 emb2 = jnp.concatenate([zero_embed, embed], axis=0)
-                eps = self.decoder.apply({"params": params["decoder"]},
+                out = self.decoder.apply({"params": params["decoder"]},
                                          xin, t, emb2)
-                eps_u, eps_c = jnp.split(eps.astype(jnp.float32), 2, axis=0)
+                # learned-variance half (if present) is dropped: the
+                # deterministic samplers never consume it
+                eps = out.astype(jnp.float32)[..., :in_ch]
+                eps_u, eps_c = jnp.split(eps, 2, axis=0)
                 eps = eps_u + g4 * (eps_c - eps_u)
                 noise = jax.vmap(lambda k: jax.random.normal(
                     jax.random.fold_in(k, i), lat_shape[1:], jnp.float32))(keys)
@@ -184,6 +234,11 @@ class Kandinsky2Pipeline:
         fn = self.compiled_bucket(batch, height, width, num_inference_steps,
                                   scheduler)
         ids = self.tokenizer.encode_batch(prompts)
+        vocab = self.config.text.vocab_size
+        if int(ids.max()) >= vocab:
+            raise ValueError(
+                f"tokenizer produced id >= vocab_size ({vocab}); "
+                "tokenizer and text-encoder config are mismatched")
         seeds_arr = np.asarray(seeds, dtype=np.uint64)
         args = self._place_batch(
             jnp.asarray(ids),

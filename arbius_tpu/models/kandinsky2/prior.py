@@ -6,12 +6,28 @@ kandinsky2.json`, `miner/src/index.ts:844-877`). Kandinsky generates in
 two diffusion stages; the first denoises a single CLIP-image-embedding
 VECTOR conditioned on the text encoding.
 
-TPU-first shape: the token sequence [text tokens, pooled text, time
-embedding, current noisy image-embed, learned query] runs through a
-causal-free transformer; sampling is an x0-prediction DDIM loop under
-`lax.scan` (the prior predicts the clean embedding directly, not epsilon
-— standard for CLIP-space priors). Everything is a [B, S, D] matmul —
-ideal MXU work; no pixel tensors exist at this stage.
+The computation graph mirrors the published diffusers `PriorTransformer`
+(the format the kandinsky-community checkpoints ship in) so converted
+weights drive this module 1:1 (see kandinsky2/convert.py):
+
+  token sequence = [ projected text states (77),
+                     projected pooled text embed (1),
+                     time embedding (1),
+                     projected noisy image embed (1),
+                     learned prd query token (1) ]  + positional embedding
+  → pre-LN transformer blocks (biased attention, plain-GELU FF)
+  → final LayerNorm → clip-embedding readout at the prd position.
+
+The prior operates in a NORMALIZED clip space: checkpoints carry
+clip_mean/clip_std vectors and the sampled embedding is de-normalized on
+the way out (`x * clip_std + clip_mean`).
+
+TPU-first shape: everything is a [B, S, D] matmul — ideal MXU work; no
+pixel tensors exist at this stage. Sampling is an x0-prediction DDIM loop
+under `lax.scan` (deterministic, eta=0); the published UnCLIP ancestral
+scheduler is replaced by this deterministic rule — weights are compatible,
+the protocol requires determinism, and the sampler is not part of the
+checkpoint.
 """
 from __future__ import annotations
 
@@ -22,14 +38,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from arbius_tpu.models.common import TransformerBlock, sinusoidal_embedding
+from arbius_tpu.models.common import Attention, sinusoidal_embedding
+
+NEG_INF = -1e9
 
 
 @dataclass(frozen=True)
 class PriorConfig:
-    clip_dim: int = 768           # image-embedding dimensionality
-    width: int = 2048
-    layers: int = 10
+    clip_dim: int = 1280          # image-embedding dimensionality (2.2: bigG)
+    width: int = 2048             # heads * head_dim
+    layers: int = 20
     heads: int = 32
     text_len: int = 77
     dtype: str = "bfloat16"
@@ -43,43 +61,101 @@ class PriorConfig:
         return cls(clip_dim=16, width=32, layers=2, heads=2, text_len=8)
 
 
+class PriorBlock(nn.Module):
+    """Pre-LN self-attention (biased projections) + plain-GELU MLP.
+
+    Matches the published prior's block (diffusers BasicTransformerBlock
+    with attention_bias=True, activation_fn="gelu", self-attention only).
+    """
+    heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        h = nn.LayerNorm(dtype=jnp.float32, name="norm1")(x).astype(self.dtype)
+        x = x + Attention(self.heads, self.head_dim, self.dtype,
+                          qkv_bias=True, name="attn1")(h, mask=mask)
+        h = nn.LayerNorm(dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
+        h = nn.Dense(x.shape[-1] * 4, dtype=self.dtype, name="ff_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="ff_out")(h)
+        return x + h
+
+
 class PriorTransformer(nn.Module):
-    """Predicts the clean image embedding from the noisy one + text."""
+    """Predicts the clean (normalized-space) image embedding.
+
+    __call__(noisy_embed[B,D], t[B], text_tokens[B,L,C], text_pooled[B,C],
+             text_mask[B,L] or None) -> x0 prediction [B, D].
+    """
     config: PriorConfig
 
     @nn.compact
-    def __call__(self, noisy_embed, t, text_tokens, text_pooled):
+    def __call__(self, noisy_embed, t, text_tokens, text_pooled, text_mask=None):
         cfg = self.config
         dt = cfg.jdtype
         B = noisy_embed.shape[0]
+        W = cfg.width
 
-        temb = sinusoidal_embedding(t, cfg.width)
-        proj = lambda name: nn.Dense(cfg.width, dtype=dt, name=name)
+        # time embedding: sinusoidal -> 2-layer MLP (published naming:
+        # time_proj + time_embedding.linear_1/linear_2; flip=True matches
+        # the published flip_sin_to_cos=True [cos, sin] layout)
+        temb = sinusoidal_embedding(t, W)
+        temb = nn.Dense(W, dtype=dt, name="time_linear_1")(temb.astype(dt))
+        temb = nn.Dense(W, dtype=dt, name="time_linear_2")(nn.silu(temb))
+
         seq = jnp.concatenate([
-            proj("text_proj")(text_tokens.astype(dt)),          # [B, L, W]
-            proj("pooled_proj")(text_pooled.astype(dt))[:, None],
-            temb.astype(dt)[:, None],
-            proj("embed_proj")(noisy_embed.astype(dt))[:, None],
+            nn.Dense(W, dtype=dt, name="text_proj")(text_tokens.astype(dt)),
+            nn.Dense(W, dtype=dt, name="pooled_proj")(
+                text_pooled.astype(dt))[:, None],
+            temb[:, None],
+            nn.Dense(W, dtype=dt, name="embed_proj")(
+                noisy_embed.astype(dt))[:, None],
             jnp.broadcast_to(
-                self.param("query", nn.initializers.normal(0.02),
-                           (1, 1, cfg.width)).astype(dt), (B, 1, cfg.width)),
+                self.param("prd_embed", nn.initializers.normal(0.02),
+                           (1, 1, W)).astype(dt), (B, 1, W)),
         ], axis=1)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, cfg.text_len + 4, cfg.width))
+                         (1, cfg.text_len + 4, W))
         seq = seq + pos.astype(dt)
+
+        mask = None
+        if text_mask is not None:
+            # padding positions attend nowhere useful; additive key mask
+            # over [text (L), pooled, time, embed, prd] — the 4 appended
+            # slots are always valid.
+            full = jnp.concatenate(
+                [text_mask.astype(jnp.float32),
+                 jnp.ones((B, 4), jnp.float32)], axis=1)
+            mask = (1.0 - full)[:, None, None, :] * NEG_INF  # [B,1,1,S]
+
         for i in range(cfg.layers):
-            seq = TransformerBlock(cfg.heads, cfg.width // cfg.heads, dt,
-                                   name=f"block_{i}")(seq)
-        out = nn.LayerNorm(dtype=jnp.float32)(seq[:, -1].astype(jnp.float32))
+            seq = PriorBlock(cfg.heads, W // cfg.heads, dt,
+                             name=f"block_{i}")(seq, mask=mask)
+        out = nn.LayerNorm(dtype=jnp.float32, name="norm_out")(
+            seq[:, -1].astype(jnp.float32))
         return nn.Dense(cfg.clip_dim, dtype=jnp.float32, name="out_proj")(out)
 
 
+def prior_stats_init(rng, shape):
+    """clip_mean starts at 0, clip_std at 1 (random init stand-in); a real
+    checkpoint overwrites both (convert_kandinsky2_prior)."""
+    del rng
+    return jnp.concatenate([jnp.zeros((1,) + shape[1:]),
+                            jnp.ones((1,) + shape[1:])], axis=0)
+
+
 def prior_sample(model: PriorTransformer, params, text_tokens, text_pooled,
-                 keys, guidance, *, steps: int = 25) -> jax.Array:
+                 keys, guidance, *, steps: int = 25, text_mask=None,
+                 clip_stats=None) -> jax.Array:
     """Deterministic DDIM (eta=0) x0-prediction sampling of the embedding.
 
     cosine alpha-bar schedule; CFG mixes conditional/unconditional x0
     predictions (text context zeroed for the unconditional branch).
+    `clip_stats` is a [2, D] array (mean row 0, std row 1); when given,
+    the sampled normalized-space embedding is de-normalized on return —
+    matching the published pipeline's post_process_latents.
     """
     B, D = text_pooled.shape[0], model.config.clip_dim
     ts = np.linspace(999, 0, steps, dtype=np.float64)
@@ -93,11 +169,17 @@ def prior_sample(model: PriorTransformer, params, text_tokens, text_pooled,
     # CFG as one doubled batch (uncond first), like the decoder loop
     tok2 = jnp.concatenate([jnp.zeros_like(text_tokens), text_tokens], axis=0)
     pool2 = jnp.concatenate([jnp.zeros_like(text_pooled), text_pooled], axis=0)
+    mask2 = None
+    if text_mask is not None:
+        # the unconditional branch sees an all-valid (zero-content) context
+        mask2 = jnp.concatenate(
+            [jnp.ones_like(text_mask), text_mask], axis=0)
 
     def body(x, i):
         t = jnp.full((2 * B,), t_cond[i])
         x0_both = model.apply({"params": params},
-                              jnp.concatenate([x, x], axis=0), t, tok2, pool2)
+                              jnp.concatenate([x, x], axis=0), t, tok2, pool2,
+                              mask2)
         x0_u, x0_c = jnp.split(x0_both, 2, axis=0)
         x0 = x0_u + g * (x0_c - x0_u)
         a_t = abar[i]
@@ -108,4 +190,6 @@ def prior_sample(model: PriorTransformer, params, text_tokens, text_pooled,
         return x_next, None
 
     x, _ = jax.lax.scan(body, x, jnp.arange(steps))
+    if clip_stats is not None:
+        x = x * clip_stats[1][None, :] + clip_stats[0][None, :]
     return x

@@ -19,6 +19,7 @@ class TextEncoderConfig:
     width: int = 768
     layers: int = 12
     heads: int = 12
+    act: str = "quick_gelu"  # ViT-L towers; open_clip bigG towers use "gelu"
     dtype: str = "bfloat16"
 
     @property
@@ -46,7 +47,7 @@ class _EncoderLayer(nn.Module):
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x).astype(dt)
         h = nn.Dense(self.cfg.width * 4, dtype=dt)(h)
-        h = quick_gelu(h)
+        h = quick_gelu(h) if self.cfg.act == "quick_gelu" else nn.gelu(h)
         h = nn.Dense(self.cfg.width, dtype=dt)(h)
         return x + h
 

@@ -36,13 +36,23 @@ class UNetConfig:
     layers_per_block: int = 2
     attention_levels: tuple[bool, ...] = (True, True, True, False)
     num_heads: int = 8
+    head_dim: int | None = None   # set → heads vary per level (ch // head_dim)
     context_dim: int = 768
     transformer_depth: int = 1
+    time_scale_shift: bool = False  # FiLM-style resnet conditioning
     dtype: str = "bfloat16"
 
     @property
     def jdtype(self):
         return jnp.dtype(self.dtype)
+
+    def heads_for(self, ch: int) -> tuple[int, int]:
+        """(num_heads, head_dim) at a channel width. SD-1.5 fixes the head
+        COUNT; other published UNets (e.g. Kandinsky's decoder) fix the
+        head DIM, so the count grows with width."""
+        if self.head_dim is not None:
+            return ch // self.head_dim, self.head_dim
+        return self.num_heads, ch // self.num_heads
 
     @classmethod
     def tiny(cls) -> "UNetConfig":
@@ -56,7 +66,7 @@ class UNet2DCondition(nn.Module):
     config: UNetConfig
 
     @nn.compact
-    def __call__(self, x, t, context):
+    def __call__(self, x, t, context, extra_temb=None):
         cfg = self.config
         dt = cfg.jdtype
         x = x.astype(dt)
@@ -64,6 +74,10 @@ class UNet2DCondition(nn.Module):
 
         temb = sinusoidal_embedding(t, cfg.block_channels[0])
         temb = TimestepEmbedding(cfg.block_channels[0] * 4, dt)(temb)
+        if extra_temb is not None:
+            # additive auxiliary conditioning (e.g. Kandinsky's projected
+            # image embedding joins the timestep embedding)
+            temb = temb + extra_temb.astype(temb.dtype)
 
         h = nn.Conv(cfg.block_channels[0], (3, 3), padding=1, dtype=dt,
                     name="conv_in")(x)
@@ -72,10 +86,12 @@ class UNet2DCondition(nn.Module):
         # encoder
         for level, ch in enumerate(cfg.block_channels):
             for j in range(cfg.layers_per_block):
-                h = ResnetBlock(ch, dt, name=f"down_{level}_res_{j}")(h, temb)
+                h = ResnetBlock(ch, dt, cfg.time_scale_shift,
+                                name=f"down_{level}_res_{j}")(h, temb)
                 if cfg.attention_levels[level]:
+                    heads, hd = cfg.heads_for(ch)
                     h = SpatialTransformer(
-                        cfg.num_heads, ch // cfg.num_heads, cfg.transformer_depth,
+                        heads, hd, cfg.transformer_depth,
                         dt, name=f"down_{level}_attn_{j}")(h, context)
                 skips.append(h)
             if level < len(cfg.block_channels) - 1:
@@ -84,20 +100,25 @@ class UNet2DCondition(nn.Module):
 
         # mid
         mid_ch = cfg.block_channels[-1]
-        h = ResnetBlock(mid_ch, dt, name="mid_res_0")(h, temb)
-        h = SpatialTransformer(cfg.num_heads, mid_ch // cfg.num_heads,
+        h = ResnetBlock(mid_ch, dt, cfg.time_scale_shift,
+                        name="mid_res_0")(h, temb)
+        mheads, mhd = cfg.heads_for(mid_ch)
+        h = SpatialTransformer(mheads, mhd,
                                cfg.transformer_depth, dt, name="mid_attn")(h, context)
-        h = ResnetBlock(mid_ch, dt, name="mid_res_1")(h, temb)
+        h = ResnetBlock(mid_ch, dt, cfg.time_scale_shift,
+                        name="mid_res_1")(h, temb)
 
         # decoder
         for level in reversed(range(len(cfg.block_channels))):
             ch = cfg.block_channels[level]
             for j in range(cfg.layers_per_block + 1):
                 h = jnp.concatenate([h, skips.pop()], axis=-1)
-                h = ResnetBlock(ch, dt, name=f"up_{level}_res_{j}")(h, temb)
+                h = ResnetBlock(ch, dt, cfg.time_scale_shift,
+                                name=f"up_{level}_res_{j}")(h, temb)
                 if cfg.attention_levels[level]:
+                    heads, hd = cfg.heads_for(ch)
                     h = SpatialTransformer(
-                        cfg.num_heads, ch // cfg.num_heads, cfg.transformer_depth,
+                        heads, hd, cfg.transformer_depth,
                         dt, name=f"up_{level}_attn_{j}")(h, context)
             if level > 0:
                 h = Upsample(ch, dt, name=f"up_{level}_us")(h)

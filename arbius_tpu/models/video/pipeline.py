@@ -209,6 +209,11 @@ class Text2VideoPipeline:
                                   num_inference_steps, scheduler)
         ids_c = self.tokenizer.encode_batch(prompts)
         ids_u = self.tokenizer.encode_batch(negs)
+        vocab = self.config.text.vocab_size
+        if int(ids_c.max()) >= vocab or int(ids_u.max()) >= vocab:
+            raise ValueError(
+                f"tokenizer produced id >= vocab_size ({vocab}); "
+                "tokenizer and text-encoder config are mismatched")
         seeds_arr = np.asarray(seeds, dtype=np.uint64)
         out = fn(params,
                  jnp.asarray(ids_c), jnp.asarray(ids_u),

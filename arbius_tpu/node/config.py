@@ -27,6 +27,29 @@ class ModelConfig:
     allowed_owners: tuple[str, ...] = ()
     checkpoint: str | None = None  # orbax param dir (None: random init)
     tiny: bool = False             # reduced topology (dev/CI hosts)
+    # prompt tokenizer: "byte" (deterministic default) or "clip_bpe"
+    # (vocab/merges files required — pairs with converted CLIP weights)
+    tokenizer: str = "byte"
+    vocab_path: str | None = None
+    merges_path: str | None = None
+    # boot self-test golden vector: {"input": {...}, "seed": int,
+    # "cid": "0x1220..."} — the TPU fleet's analogue of the reference's
+    # pinned kandinsky CID (miner/src/index.ts:989-999)
+    golden: dict | None = None
+
+    def __post_init__(self):
+        if self.tokenizer not in ("byte", "clip_bpe"):
+            raise ConfigError(f"model {self.id}: unknown tokenizer "
+                              f"{self.tokenizer!r}")
+        if self.tokenizer == "clip_bpe" and not (
+                self.vocab_path and self.merges_path):
+            raise ConfigError(f"model {self.id}: clip_bpe tokenizer needs "
+                              "vocab_path and merges_path")
+        if self.golden is not None and not (
+                isinstance(self.golden, dict)
+                and {"input", "seed", "cid"} <= set(self.golden)):
+            raise ConfigError(f"model {self.id}: golden needs "
+                              "input/seed/cid keys")
 
 
 @dataclass(frozen=True)
@@ -46,6 +69,25 @@ class StakeConfig:
     check_interval: int = 600
     buffer_min_percent: float = 0.01
     buffer_percent: float = 0.20
+
+
+@dataclass(frozen=True)
+class IpfsConfig:
+    """Pinning strategy selection (reference `types.ts:3-54` ipfs section):
+    local = the node's own ContentStore + gateway (needs store_dir);
+    http_daemon = kubo /api/v0/add; pinata = Pinata's pinning API."""
+    strategy: str = "local"
+    daemon_url: str = ""
+    pinata_jwt: str = ""
+    timeout: float = 60.0
+
+    def __post_init__(self):
+        if self.strategy not in ("local", "http_daemon", "pinata"):
+            raise ConfigError(f"unknown ipfs strategy {self.strategy!r}")
+        if self.strategy == "http_daemon" and not self.daemon_url:
+            raise ConfigError("ipfs strategy http_daemon needs daemon_url")
+        if self.strategy == "pinata" and not self.pinata_jwt:
+            raise ConfigError("ipfs strategy pinata needs pinata_jwt")
 
 
 @dataclass(frozen=True)
@@ -72,6 +114,7 @@ class MiningConfig:
     compile_cache_dir: str | None = ".jax_cache"  # persistent XLA cache
     store_dir: str | None = None     # content store root (None: don't pin)
     rpc_port: int | None = None      # control RPC + explorer + /ipfs gateway
+    ipfs: IpfsConfig = IpfsConfig()  # pinning strategy
 
 
 @dataclass(frozen=True)
@@ -123,6 +166,7 @@ def load_config(raw: str | dict) -> MiningConfig:
                             dict(allowed_owners=owners, **m), "models"))
     automine = build(AutomineConfig, obj.pop("automine", {}), "automine")
     stake = build(StakeConfig, obj.pop("stake", {}), "stake")
+    ipfs = build(IpfsConfig, obj.pop("ipfs", {}), "ipfs")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
-                      **obj), "config")
+                      ipfs=ipfs, **obj), "config")

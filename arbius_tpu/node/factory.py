@@ -36,12 +36,26 @@ def _params_for(pipe, m: ModelConfig):
     return pipe.init_params(seed=0)
 
 
+def _tokenizer_for(m: ModelConfig, text_cfg):
+    """ModelConfig.tokenizer → live tokenizer (None = pipeline default).
+
+    `clip_bpe` loads the standard CLIP vocab/merges from the configured
+    local files — the pairing real converted CLIP weights need (byte-level
+    ids feed garbage conditioning into a pretrained text tower)."""
+    if m.tokenizer == "clip_bpe":
+        from arbius_tpu.models.sd15 import CLIPBPETokenizer
+
+        tok = CLIPBPETokenizer.from_files(m.vocab_path, m.merges_path)
+        tok.max_length = text_cfg.max_length
+        return tok
+    return tiny_byte_tokenizer(text_cfg) if m.tiny else None
+
+
 def _sd15(m: ModelConfig, mesh):
     from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline
 
     cfg = SD15Config.tiny() if m.tiny else SD15Config()
-    tok = tiny_byte_tokenizer(cfg.text) if m.tiny else None
-    pipe = SD15Pipeline(cfg, tokenizer=tok, mesh=mesh)
+    pipe = SD15Pipeline(cfg, tokenizer=_tokenizer_for(m, cfg.text), mesh=mesh)
     return SD15Runner(pipe, _params_for(pipe, m))
 
 
@@ -58,8 +72,8 @@ def _kandinsky2(m: ModelConfig, mesh):
     from arbius_tpu.models.kandinsky2 import Kandinsky2Config, Kandinsky2Pipeline
 
     cfg = Kandinsky2Config.tiny() if m.tiny else Kandinsky2Config()
-    tok = tiny_byte_tokenizer(cfg.text) if m.tiny else None
-    pipe = Kandinsky2Pipeline(cfg, tokenizer=tok, mesh=mesh)
+    pipe = Kandinsky2Pipeline(cfg, tokenizer=_tokenizer_for(m, cfg.text),
+                              mesh=mesh)
     return Kandinsky2Runner(pipe, _params_for(pipe, m))
 
 
@@ -67,8 +81,8 @@ def _video(m: ModelConfig, mesh):
     from arbius_tpu.models.video import Text2VideoConfig, Text2VideoPipeline
 
     cfg = Text2VideoConfig.tiny() if m.tiny else Text2VideoConfig()
-    tok = tiny_byte_tokenizer(cfg.text) if m.tiny else None
-    pipe = Text2VideoPipeline(cfg, tokenizer=tok, mesh=mesh)
+    pipe = Text2VideoPipeline(cfg, tokenizer=_tokenizer_for(m, cfg.text),
+                              mesh=mesh)
     return Text2VideoRunner(pipe, _params_for(pipe, m))
 
 
@@ -112,7 +126,12 @@ def build_registry(cfg: MiningConfig, *, mesh=None,
             log.warning("model %s: unknown template %r; skipping",
                         m.id, m.template)
             continue
+        golden = None
+        if m.golden is not None:
+            golden = (dict(m.golden["input"]), int(m.golden["seed"]),
+                      str(m.golden["cid"]))
         reg.register(RegisteredModel(
             id=m.id, template=load_template(m.template), runner=runner,
-            min_fee=m.min_fee, allowed_owners=list(m.allowed_owners)))
+            min_fee=m.min_fee, allowed_owners=list(m.allowed_owners),
+            golden=golden))
     return reg

@@ -73,7 +73,7 @@ class BootError(RuntimeError):
 class MinerNode:
     def __init__(self, chain: LocalChain, config: MiningConfig,
                  registry: ModelRegistry, db: NodeDB | None = None,
-                 store=None):
+                 store=None, pinner=None):
         self.chain = chain
         self.config = config
         self.registry = registry
@@ -83,6 +83,11 @@ class MinerNode:
 
             store = ContentStore(config.store_dir)
         self.store = store
+        if pinner is None:
+            from arbius_tpu.node.pinners import build_pinner
+
+            pinner = build_pinner(config.ipfs, store)
+        self.pinner = pinner
         self.metrics = NodeMetrics()
         self._retry_sleep = lambda s: None  # injectable; chain time is fake
 
@@ -291,8 +296,8 @@ class MinerNode:
             return
         hydrated["seed"] = taskid2seed(taskid)
         self.db.store_task_input(taskid, "", hydrated)
-        if self.store is not None:
-            # mirror the raw input so contestation evidence stays
+        if self.store is not None or self.pinner is not None:
+            # pin the raw input so contestation evidence stays
             # retrievable (index.ts:175-186 pinTaskInput)
             self.db.queue_job("pinTaskInput", {"taskid": taskid},
                               concurrent=True)
@@ -352,8 +357,10 @@ class MinerNode:
                 time.perf_counter() - w_start)
             w_commit = time.perf_counter()
             for (job, _), (cid, files) in zip(entries, results):
-                self._store_solution(cid, files)
                 try:
+                    # pin BEFORE revealing: a revealed CID whose bytes are
+                    # nowhere fetchable is exactly what contestation slashes
+                    self._store_solution(job.data["taskid"], cid, files)
                     self._commit_reveal(job.data["taskid"], cid, t_start)
                     self.db.delete_job(job.id)
                     done += 1
@@ -364,27 +371,55 @@ class MinerNode:
                 time.perf_counter() - w_commit)
         return done
 
-    def _store_solution(self, cid: str, files: dict) -> None:
-        """Persist solution bytes under their CID (data availability: the
-        committed CID must be fetchable — ipfs.ts:28-76 equivalent)."""
-        if self.store is None or not files:
+    def _store_solution(self, taskid: str, cid: str, files: dict) -> None:
+        """Pin solution bytes under their CID (data availability: the
+        committed CID must be fetchable — ipfs.ts:28-76 equivalent) via the
+        configured strategy, with the reference's expretry envelope.
+
+        Remote strategies additionally mirror into the local store (the
+        node's own gateway keeps serving). If pinning exhausts its retries
+        AND no local mirror holds the bytes, this RAISES — the caller must
+        not reveal a CID nobody can fetch."""
+        if not files:
             return
         from arbius_tpu.l0.cid import cid_hex
+        from arbius_tpu.node.pinners import LocalPinner
+        from arbius_tpu.node.retry import expretry
 
-        stored = cid_hex(self.store.put_files(files))
-        if stored != cid:
+        mirrored = False
+        if self.store is not None and not isinstance(self.pinner, LocalPinner):
+            self.store.put_files(files)
+            mirrored = True
+        if self.pinner is None:
+            return
+        try:
+            pinned = cid_hex(expretry(
+                lambda: self.pinner.pin_files(files, taskid=taskid),
+                sleep=self._retry_sleep))
+        except Exception as e:  # noqa: BLE001 — availability decision below
+            if not mirrored:
+                raise  # no copy exists anywhere: block the reveal
+            log.error("pinning %s failed (serving from local mirror): %r",
+                      taskid, e)
+            return
+        if pinned != cid:
             # same pure function on the same bytes; a mismatch means disk
             # corruption or a codec bug — keep mining but say so loudly
-            log.error("store/commit CID mismatch: %s != %s", stored, cid)
+            log.error("pin/commit CID mismatch: %s != %s", pinned, cid)
 
     def _process_pin_task_input(self, data: dict) -> None:
-        """Mirror the raw task input into the content store."""
-        if self.store is None:
-            return
+        """Pin the raw task input through the configured strategy (the
+        reference's pinTaskInput goes through the same pinFileToIPFS
+        switch, index.ts:175-186) and mirror it into the local store."""
         raw = self.chain.get_task_input_bytes(data["taskid"])
         if raw is None:
             raise ValueError(f"no input bytes for {data['taskid']}")
-        self.store.put_blob(raw)
+        if self.store is not None:
+            self.store.put_blob(raw)
+        from arbius_tpu.node.pinners import LocalPinner
+
+        if self.pinner is not None and not isinstance(self.pinner, LocalPinner):
+            self.pinner.pin_blob(raw, filename=data["taskid"])
 
     def _maybe_profile(self):
         """jax.profiler trace around every Nth solve dispatch when the

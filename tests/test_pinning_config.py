@@ -1,0 +1,260 @@
+"""Pinning-strategy selection + Pinata pinner + tokenizer config knob.
+
+Covers VERDICT r2 items 4 (ipfs.strategy reaches the node's production
+path; Pinata parity with `miner/src/ipfs.ts:79-114`) and 3's wiring half
+(clip_bpe tokenizer selected from ModelConfig with vocab/merges files;
+golden tokenization checked against the documented OpenAI CLIP example
+ids — the fixture vocab pins those words at their real CLIP ids).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from arbius_tpu.l0.base58 import b58encode
+from arbius_tpu.l0.cid import cid_of_solution_files
+from arbius_tpu.node.config import ConfigError, IpfsConfig, load_config
+from arbius_tpu.node.pinners import (
+    HttpDaemonPinner,
+    LocalPinner,
+    PinataPinner,
+    PinMismatchError,
+    build_pinner,
+)
+from arbius_tpu.node.store import ContentStore
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+FILES = {"out-1.png": b"\x89PNG fake" * 32}
+
+
+# -- config knob -----------------------------------------------------------
+
+def test_ipfs_config_defaults_to_local():
+    cfg = load_config({})
+    assert cfg.ipfs.strategy == "local"
+
+
+def test_ipfs_config_validates_strategy():
+    with pytest.raises(ConfigError, match="strategy"):
+        load_config({"ipfs": {"strategy": "carrier-pigeon"}})
+    with pytest.raises(ConfigError, match="daemon_url"):
+        load_config({"ipfs": {"strategy": "http_daemon"}})
+    with pytest.raises(ConfigError, match="pinata_jwt"):
+        load_config({"ipfs": {"strategy": "pinata"}})
+
+
+def test_tokenizer_config_validates():
+    model = {"id": "0x1", "template": "anythingv3"}
+    with pytest.raises(ConfigError, match="tokenizer"):
+        load_config({"models": [dict(model, tokenizer="word2vec")]})
+    with pytest.raises(ConfigError, match="vocab_path"):
+        load_config({"models": [dict(model, tokenizer="clip_bpe")]})
+    cfg = load_config({"models": [dict(
+        model, tokenizer="clip_bpe",
+        vocab_path="v.json", merges_path="m.txt")]})
+    assert cfg.models[0].tokenizer == "clip_bpe"
+
+
+def test_golden_config_validates():
+    model = {"id": "0x1", "template": "anythingv3"}
+    with pytest.raises(ConfigError, match="golden"):
+        load_config({"models": [dict(model, golden={"seed": 1})]})
+    cfg = load_config({"models": [dict(model, golden={
+        "input": {"prompt": "arbius test cat"}, "seed": 1337,
+        "cid": "0x1220" + "ab" * 32})]})
+    assert cfg.models[0].golden["seed"] == 1337
+
+
+# -- strategy factory ------------------------------------------------------
+
+def test_build_pinner_per_strategy(tmp_path):
+    store = ContentStore(str(tmp_path))
+    assert isinstance(build_pinner(IpfsConfig(), store), LocalPinner)
+    assert build_pinner(IpfsConfig(), None) is None
+    p = build_pinner(IpfsConfig(strategy="http_daemon",
+                                daemon_url="http://127.0.0.1:5001"), None)
+    assert isinstance(p, HttpDaemonPinner)
+    p = build_pinner(IpfsConfig(strategy="pinata", pinata_jwt="jwt"), None)
+    assert isinstance(p, PinataPinner)
+
+
+# -- pinata pinner ---------------------------------------------------------
+
+def _fake_pinata_opener(responses: list, seen: list):
+    def opener(req, timeout=None):
+        seen.append(req)
+        return io.BytesIO(json.dumps(responses.pop(0)).encode())
+    return opener
+
+
+def test_pinata_pinner_pins_and_verifies():
+    root = cid_of_solution_files(FILES)
+    seen: list = []
+    pinner = PinataPinner("test-jwt", opener=_fake_pinata_opener(
+        [{"IpfsHash": b58encode(root)}], seen))
+    assert pinner.pin_files(FILES, taskid="0xabc") == root
+    req = seen[0]
+    assert req.full_url == PinataPinner.API_URL
+    assert req.get_header("Authorization") == "Bearer test-jwt"
+    body = req.data.decode("latin-1")
+    assert 'filename="0xabc/out-1.png"' in body
+    assert '"cidVersion": 0' in body
+
+
+def test_pinata_pinner_rejects_mismatched_root():
+    pinner = PinataPinner("jwt", opener=_fake_pinata_opener(
+        [{"IpfsHash": "QmWrongHash"}], []))
+    with pytest.raises(PinMismatchError):
+        pinner.pin_files(FILES)
+
+
+# -- node integration: each strategy drives _store_solution -----------------
+
+class _EchoOpener:
+    """Plays a well-behaved pinning service: recomputes the dir-wrap CID
+    from the multipart body it receives, like a real daemon would."""
+
+    def __init__(self):
+        self.reqs = []
+
+    def __call__(self, req, timeout=None):
+        self.reqs.append(req)
+        files = {}
+        for part in req.data.split(b"--" + PinataPinner.BOUNDARY.encode()):
+            if b'name="file"' not in part:
+                continue
+            head, _, body = part.partition(b"\r\n\r\n")
+            name = head.split(b'filename="')[1].split(b'"')[0].decode()
+            files[name.split("/", 1)[-1]] = body[:-2]  # strip \r\n
+        root = cid_of_solution_files(files)
+        return io.BytesIO(json.dumps({"IpfsHash": b58encode(root)}).encode())
+
+
+def _mine_one(tmp_path, ipfs: IpfsConfig, opener=None):
+    """Drive one task through solve with the given pinning strategy."""
+    from tests.test_node import build_world, drain, submit
+
+    eng, tok, chain, node, mid = build_world(
+        store_dir=str(tmp_path / "store"), ipfs=ipfs)
+    if opener is not None:
+        node.pinner.opener = opener
+    taskid = submit(eng, mid)
+    assert drain(node) >= 1
+    assert eng.solutions, "no solution was committed"
+    return node
+
+
+def test_node_mines_with_local_strategy(tmp_path):
+    node = _mine_one(tmp_path, IpfsConfig())
+    assert isinstance(node.pinner, LocalPinner)
+    assert node.store.stats()["files"] > 0
+
+
+def test_node_mines_with_pinata_strategy(tmp_path):
+    echo = _EchoOpener()
+    node = _mine_one(tmp_path,
+                     IpfsConfig(strategy="pinata", pinata_jwt="j"),
+                     opener=echo)
+    assert isinstance(node.pinner, PinataPinner)
+    assert echo.reqs, "pinata endpoint was never called"
+    # remote strategy still mirrors into the local store for the gateway
+    assert node.store.stats()["files"] > 0
+
+
+def test_node_mines_with_http_daemon_strategy(tmp_path):
+    class DaemonOpener(_EchoOpener):
+        def __call__(self, req, timeout=None):
+            self.reqs.append(req)
+            files = {}
+            for part in req.data.split(
+                    b"--" + HttpDaemonPinner.BOUNDARY.encode()):
+                if b'name="file"' not in part:
+                    continue
+                head, _, body = part.partition(b"\r\n\r\n")
+                name = head.split(b'filename="')[1].split(b'"')[0].decode()
+                files[name] = body[:-2]
+            root = cid_of_solution_files(files)
+            lines = [json.dumps({"Name": n, "Hash": "x"}) for n in files]
+            lines.append(json.dumps({"Name": "", "Hash": b58encode(root)}))
+            return io.BytesIO("\n".join(lines).encode())
+
+    echo = DaemonOpener()
+    node = _mine_one(
+        tmp_path,
+        IpfsConfig(strategy="http_daemon", daemon_url="http://127.0.0.1:1"),
+        opener=echo)
+    assert isinstance(node.pinner, HttpDaemonPinner)
+    assert echo.reqs, "daemon endpoint was never called"
+
+
+def test_pin_failure_does_not_stop_mining(tmp_path):
+    def broken_opener(req, timeout=None):
+        raise OSError("network down")
+
+    node = _mine_one(tmp_path,
+                     IpfsConfig(strategy="pinata", pinata_jwt="j"),
+                     opener=broken_opener)
+    # solution still committed (asserted in _mine_one) and mirrored locally
+    assert node.store.stats()["files"] > 0
+
+
+# -- clip_bpe tokenizer golden ids -----------------------------------------
+
+def test_clip_bpe_documented_example_ids():
+    """OpenAI's documented CLIP example: 'a photo of a cat' tokenizes to
+    [49406, 320, 1125, 539, 320, 2368, 49407]; the fixture vocab pins
+    those words at their published ids and the merges assemble them."""
+    from arbius_tpu.models.sd15 import CLIPBPETokenizer
+
+    tok = CLIPBPETokenizer.from_files(
+        os.path.join(FIXTURES, "clip_vocab.json"),
+        os.path.join(FIXTURES, "clip_merges.txt"))
+    ids = tok.encode("a photo of a cat")
+    expected = [49406, 320, 1125, 539, 320, 2368, 49407]
+    assert list(ids[:7]) == expected
+    assert set(ids[7:].tolist()) == {49407}
+    assert ids.shape == (77,) and ids.dtype == np.int32
+    # case/whitespace normalization matches CLIP's
+    np.testing.assert_array_equal(
+        tok.encode("  A  Photo OF a CAT "), ids)
+    # 'a dog' exercises a different merge chain
+    assert list(tok.encode("a dog")[:4]) == [49406, 320, 1929, 49407]
+
+
+def test_factory_selects_clip_bpe_tokenizer():
+    from arbius_tpu.models.sd15 import CLIPBPETokenizer
+    from arbius_tpu.node.config import load_config
+    from arbius_tpu.node.factory import build_registry
+
+    cfg = load_config({"models": [{
+        "id": "0x" + "11" * 32, "template": "anythingv3", "tiny": True,
+        "tokenizer": "clip_bpe",
+        "vocab_path": os.path.join(FIXTURES, "clip_vocab.json"),
+        "merges_path": os.path.join(FIXTURES, "clip_merges.txt"),
+    }]})
+    reg = build_registry(cfg)
+    m = reg.get("0x" + "11" * 32)
+    tok = m.runner.pipeline.tokenizer
+    assert isinstance(tok, CLIPBPETokenizer)
+    # max_length follows the (tiny) text tower
+    assert tok.max_length == m.runner.pipeline.config.text.max_length
+
+
+def test_factory_wires_golden_vector():
+    from arbius_tpu.node.config import load_config
+    from arbius_tpu.node.factory import build_registry
+
+    golden = {"input": {"prompt": "arbius test cat"}, "seed": 1337,
+              "cid": "0x1220" + "cd" * 32}
+    cfg = load_config({"models": [{
+        "id": "0x" + "22" * 32, "template": "anythingv3", "tiny": True,
+        "golden": golden,
+    }]})
+    reg = build_registry(cfg)
+    m = reg.get("0x" + "22" * 32)
+    assert m.golden == ({"prompt": "arbius test cat"}, 1337,
+                        "0x1220" + "cd" * 32)
