@@ -29,12 +29,14 @@ from arbius_tpu.chain.governance import (
     Proposal,
     ProposalState,
 )
+from arbius_tpu.chain.l1token import L1CustomGateway, L1Token, L2GatewayRouter
 from arbius_tpu.chain.token import TokenLedger
 from arbius_tpu.chain.wallet import Wallet, recover_address
 
 __all__ = [
     "Contestation", "Engine", "EngineError", "Event", "GovernanceError",
-    "Governor", "Model", "Proposal", "ProposalState", "Solution", "Task",
+    "Governor", "L1CustomGateway", "L1Token", "L2GatewayRouter",
+    "Model", "Proposal", "ProposalState", "Solution", "Task",
     "Validator", "TokenLedger", "Wallet", "recover_address",
     "BASE_TOKEN_STARTING_REWARD", "STARTING_ENGINE_TOKEN_AMOUNT", "WAD",
     "diff_mul", "reward", "target_ts",

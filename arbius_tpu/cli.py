@@ -109,7 +109,7 @@ def cmd_demo_mine(args) -> int:
         # tunnel regardless of the env var (hanging when it's unhealthy)
         from arbius_tpu.utils import force_cpu_devices
 
-        force_cpu_devices(1)
+        force_cpu_devices(1, strict=False)
     from arbius_tpu.chain import Engine, TokenLedger, WAD
     from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
     from arbius_tpu.node import (

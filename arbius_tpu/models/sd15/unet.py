@@ -66,7 +66,7 @@ class UNet2DCondition(nn.Module):
     config: UNetConfig
 
     @nn.compact
-    def __call__(self, x, t, context, extra_temb=None):
+    def __call__(self, x, t, context):
         cfg = self.config
         dt = cfg.jdtype
         x = x.astype(dt)
@@ -74,10 +74,6 @@ class UNet2DCondition(nn.Module):
 
         temb = sinusoidal_embedding(t, cfg.block_channels[0])
         temb = TimestepEmbedding(cfg.block_channels[0] * 4, dt)(temb)
-        if extra_temb is not None:
-            # additive auxiliary conditioning (e.g. Kandinsky's projected
-            # image embedding joins the timestep embedding)
-            temb = temb + extra_temb.astype(temb.dtype)
 
         h = nn.Conv(cfg.block_channels[0], (3, 3), padding=1, dtype=dt,
                     name="conv_in")(x)

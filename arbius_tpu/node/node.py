@@ -388,8 +388,13 @@ class MinerNode:
 
         mirrored = False
         if self.store is not None and not isinstance(self.pinner, LocalPinner):
-            self.store.put_files(files)
-            mirrored = True
+            stored = cid_hex(self.store.put_files(files))
+            if stored != cid:
+                # the mirror may end up the only copy (remote pin can
+                # fail below) — never let a silently-corrupt sole copy
+                # back a reveal
+                log.error("mirror/commit CID mismatch: %s != %s", stored, cid)
+            mirrored = stored == cid
         if self.pinner is None:
             return
         try:
@@ -417,9 +422,15 @@ class MinerNode:
         if self.store is not None:
             self.store.put_blob(raw)
         from arbius_tpu.node.pinners import LocalPinner
+        from arbius_tpu.node.retry import expretry
 
         if self.pinner is not None and not isinstance(self.pinner, LocalPinner):
-            self.pinner.pin_blob(raw, filename=data["taskid"])
+            # same expretry envelope the reference's pinTaskInput runs in
+            # (index.ts:175-186) — one transient HTTP error must not
+            # quarantine the job and lose contestation evidence
+            expretry(lambda: self.pinner.pin_blob(raw,
+                                                  filename=data["taskid"]),
+                     sleep=self._retry_sleep)
 
     def _maybe_profile(self):
         """jax.profiler trace around every Nth solve dispatch when the

@@ -15,13 +15,18 @@ import os
 import re
 
 
-def force_cpu_devices(n_devices: int, spare: tuple[str, ...] = ("cpu", "tpu")) -> None:
+def force_cpu_devices(n_devices: int, spare: tuple[str, ...] = ("cpu", "tpu"),
+                      *, strict: bool = True) -> None:
     """Force the CPU platform with `n_devices` virtual devices.
 
     Must run before any jax backend is initialized: XLA_FLAGS is parsed
     once per process, so a late call is unrecoverable — it raises
     RuntimeError (before mutating any global state) rather than leaving
-    the caller with a silently wrong device count.
+    the caller with a silently wrong device count. With ``strict=False``
+    an already-initialized CPU backend with at least `n_devices` devices
+    is accepted as-is (for callers that only need "on CPU, don't dial
+    the tunnel" and may run inside a process that forced CPU earlier,
+    e.g. demo-mine under pytest).
     """
     import jax
 
@@ -30,6 +35,9 @@ def force_cpu_devices(n_devices: int, spare: tuple[str, ...] = ("cpu", "tpu")) -
     except Exception:  # pragma: no cover - jax internals moved
         _xb = None
     if _xb is not None and getattr(_xb, "_backends", None):
+        if (not strict and jax.default_backend() == "cpu"
+                and jax.device_count() >= n_devices):
+            return
         raise RuntimeError(
             "jax backend already initialized in this process; "
             "force_cpu_devices must run in a fresh interpreter")

@@ -18,6 +18,8 @@ from arbius_tpu.models.sd15.convert import (
     unet_key_for,
 )
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 
 @pytest.fixture(scope="module")
 def unet_params():

@@ -10,6 +10,8 @@ import pytest
 from arbius_tpu.ops.flash import flash_attention
 from arbius_tpu.ops.ring import sp_attention_reference
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 
 def rand(shape, key, dtype=jnp.float32):
     return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)

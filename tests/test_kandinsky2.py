@@ -20,6 +20,8 @@ from arbius_tpu.models.kandinsky2 import (
 )
 from arbius_tpu.models.sd15 import ByteTokenizer
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 
 def tiny_pipe(mesh=None):
     return Kandinsky2Pipeline(

@@ -29,6 +29,8 @@ from arbius_tpu.models.kandinsky2.convert import (
 from arbius_tpu.models.sd15.convert import ConversionError
 from arbius_tpu.node.factory import tiny_byte_tokenizer
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 
 @pytest.fixture(scope="module")
 def kparams():

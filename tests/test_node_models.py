@@ -30,6 +30,8 @@ from arbius_tpu.node import (
 )
 from arbius_tpu.templates.engine import load_template
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 MINER = "0x" + "aa" * 20
 USER = "0x" + "01" * 20
 

@@ -1,0 +1,52 @@
+"""Production-composition test: the real `MinerNode.run()` wall-clock loop
+driving mining in a background thread while `ControlRPC` serves the
+operator API — the exact process shape `node-run` assembles
+(`miner/src/start.ts:11-52`: RPC server up, then main loop forever).
+
+Every other node test drives `tick()` directly for determinism; this one
+covers the composition those tests skip: run()'s poll cadence, the stop
+flag, and concurrent RPC reads against a live node.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+from arbius_tpu.node.rpc import ControlRPC
+
+from test_node import build_world, submit
+
+
+def test_run_loop_mines_and_serves_rpc():
+    eng, tok, chain, node, mid = build_world(poll_interval_ms=5)
+    rpc = ControlRPC(node)
+    rpc.start()
+    stop = threading.Event()
+    t = threading.Thread(target=node.run, kwargs={"stop": stop.is_set},
+                         daemon=True)
+    t.start()
+    try:
+        tid = submit(eng, mid)
+        key = bytes.fromhex(tid[2:])
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and key not in eng.solutions:
+            time.sleep(0.02)
+        assert key in eng.solutions, "run() loop never solved the task"
+        assert eng.solutions[key].validator == chain.address
+
+        url = f"http://127.0.0.1:{rpc.port}/api/metrics"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            metrics = json.load(resp)
+        assert metrics["solutions_submitted"] >= 1
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rpc.port}/api/tasks", timeout=5) as resp:
+            tasks = json.load(resp)
+        assert any(row["taskid"] == tid for row in tasks)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        rpc.stop()
+    assert not t.is_alive(), "run() did not honor the stop flag"

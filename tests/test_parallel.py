@@ -17,6 +17,8 @@ from arbius_tpu.parallel import (
 )
 from arbius_tpu.parallel.sharding import DEFAULT_TP_RULES
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 
 def test_devices_virtualized():
     assert len(jax.devices()) == 8

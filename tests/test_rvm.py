@@ -16,6 +16,8 @@ from arbius_tpu.models.rvm import (
     RVMStep,
 )
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 
 def synth_video(t=4, h=32, w=32, seed=0):
     rng = np.random.default_rng(seed)

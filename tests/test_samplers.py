@@ -12,6 +12,7 @@ import pytest
 
 from arbius_tpu.schedulers import SAMPLER_NAMES, alphas_cumprod, get_sampler
 
+
 X0 = 3.0  # the delta-distribution target
 SHAPE = (4,)
 

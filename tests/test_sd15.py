@@ -16,6 +16,8 @@ from arbius_tpu.models.sd15 import (
     VAEDecoder,
 )
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 
 class TestTokenizer:
     def test_shape_and_specials(self):

@@ -3,6 +3,9 @@ import numpy as np
 
 from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
 from arbius_tpu.parallel import MeshSpec, build_mesh
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.model]
 
 
 def test_sd15_dp_mesh_reproducible():

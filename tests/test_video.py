@@ -21,6 +21,8 @@ from arbius_tpu.models.sd15 import ByteTokenizer
 from arbius_tpu.ops import ring_attention, sp_attention_reference
 from arbius_tpu.parallel import MeshSpec, build_mesh
 
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
 
 def tok():
     return ByteTokenizer(max_length=16, bos_id=257, eos_id=258)
