@@ -23,12 +23,14 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from arbius_tpu.chain.engine import Engine, EngineError
+from arbius_tpu.chain.governance import GovernanceError, Governor
 from arbius_tpu.chain.rlp import decode_signed_eip1559
 from arbius_tpu.chain.rpc_client import RpcError
 from arbius_tpu.l0.abi import abi_decode, abi_encode
 from arbius_tpu.l0.keccak import keccak256
 
 TOKEN_ADDRESS = "0x" + "70" * 20
+GOVERNOR_ADDRESS = "0x" + "60" * 20
 
 _ZERO32 = b"\x00" * 32
 
@@ -138,6 +140,86 @@ class DevnetNode:
             _selector("transfer(address,uint256)"): (
                 ["address", "uint256"],
                 lambda s, v: eng.token.transfer(s, v[0], v[1])),
+            _selector("delegate(address)"): (
+                ["address"],
+                lambda s, v: eng.token.delegate(s, v[0])),
+        }
+
+        # -- governor (GovernorV1/TimelockV1 over RPC) --------------------
+        # Our ABI codec has no dynamic arrays, so the RPC surface takes
+        # SINGLE-action proposals: propose(target, value, calldata,
+        # description). Multi-action proposals stay available in-process
+        # (chain/governance.py); the reference CLI's governance verbs
+        # (`contract/tasks/index.ts:244-360`) are likewise one action per
+        # proposal in practice.
+        self.governor = Governor(eng)
+        self.governor_address = GOVERNOR_ADDRESS
+
+        # calls a passed proposal may execute, dispatched by (target,
+        # selector) with the timelock as the implied sender — the
+        # governance-gated admin surface (setSolutionMineableRate via
+        # governance: `contract/test/governance.test.ts:128-444`)
+        self._timelock_calls = {
+            (self.engine_address,
+             _selector("setSolutionMineableRate(bytes32,uint256)")): (
+                ["bytes32", "uint256"],
+                lambda v: eng.set_solution_mineable_rate(v[0], v[1])),
+            (self.engine_address, _selector("setPaused(bool)")): (
+                ["bool"], lambda v: setattr(eng, "paused", v[0])),
+        }
+
+        def _gov_action(target: str, value: int, calldata: bytes):
+            if value != 0:
+                raise DevnetError("devnet proposals cannot carry ETH value")
+            key = (target.lower(), calldata[:4])
+            if key not in self._timelock_calls:
+                raise DevnetError(
+                    f"no governance-executable call at {target} for "
+                    f"{calldata[:4].hex()}")
+            types, fn = self._timelock_calls[key]
+            values = abi_decode(types, calldata[4:])
+            return lambda: fn(values)
+
+        def _propose(s, v):
+            action = _gov_action(v[0], v[1], v[2])
+            return self.governor.propose(s, [action], v[3])
+
+        self._governor_writes = {
+            _selector("propose(address,uint256,bytes,string)"): (
+                ["address", "uint256", "bytes", "string"], _propose),
+            _selector("castVote(bytes32,uint8)"): (
+                ["bytes32", "uint8"],
+                lambda s, v: self.governor.cast_vote(s, v[0], v[1])),
+            _selector("queue(bytes32)"): (
+                ["bytes32"], lambda s, v: self.governor.queue(v[0])),
+            _selector("execute(bytes32)"): (
+                ["bytes32"], lambda s, v: self.governor.execute(v[0])),
+        }
+
+        def _gov_proposal(pid: bytes):
+            p = self.governor.proposals.get(pid)
+            if p is None:
+                raise DevnetError("unknown proposal")
+            return p
+
+        self._governor_views = {
+            _selector("state(bytes32)"): (
+                ["bytes32"], ["uint8"],
+                lambda v: [self.governor.state(v[0]).value]),
+            _selector("proposalVotes(bytes32)"): (
+                ["bytes32"], ["uint256", "uint256", "uint256"],
+                lambda v: [_gov_proposal(v[0]).against_votes,
+                           _gov_proposal(v[0]).for_votes,
+                           _gov_proposal(v[0]).abstain_votes]),
+            _selector("proposalSnapshot(bytes32)"): (
+                ["bytes32"], ["uint256"],
+                lambda v: [_gov_proposal(v[0]).snapshot_block]),
+            _selector("proposalDeadline(bytes32)"): (
+                ["bytes32"], ["uint256"],
+                lambda v: [_gov_proposal(v[0]).deadline_block]),
+            _selector("proposalEta(bytes32)"): (
+                ["bytes32"], ["uint256"],
+                lambda v: [_gov_proposal(v[0]).eta or 0]),
         }
 
         # views: selector -> (arg types, result types, fn(values) -> list)
@@ -162,7 +244,15 @@ class DevnetNode:
             return ([w.staked, w.since, w.addr]
                     if w else [0, 0, "0x" + "00" * 20])
 
+        def _model(v):
+            m = eng.models.get(v[0])
+            return ([m.fee, m.addr, m.rate, m.cid]
+                    if m else [0, "0x" + "00" * 20, 0, b""])
+
         self._engine_views = {
+            _selector("models(bytes32)"): (
+                ["bytes32"], ["uint256", "address", "uint256", "bytes"],
+                _model),
             _selector("tasks(bytes32)"): (
                 ["bytes32"],
                 ["bytes32", "uint256", "address", "uint64", "uint8", "bytes"],
@@ -265,7 +355,22 @@ class DevnetNode:
             eng.advance_time(int(params[0]), blocks=0)
             return hex(int(params[0]))
         if method == "evm_mine":
+            # standard semantics: optional param is a TIMESTAMP for the
+            # mined block (ganache/hardhat), never a count
+            if params:
+                ts = (int(params[0], 16) if isinstance(params[0], str)
+                      else int(params[0]))
+                if ts > eng.now:
+                    eng.advance_time(ts - eng.now, blocks=0)
             eng.mine_block()
+            return hex(eng.block_number)
+        if method == "hardhat_mine":
+            # batch mining lives under its real hardhat name, so voting
+            # delays of thousands of blocks don't need thousands of calls
+            count = (int(params[0], 16) if isinstance(params[0], str)
+                     else int(params[0])) if params else 1
+            for _ in range(count):
+                eng.mine_block()
             return hex(eng.block_number)
         raise DevnetError(f"method {method} not supported")
 
@@ -274,12 +379,17 @@ class DevnetNode:
         data = bytes.fromhex(call["data"][2:])
         views = (self._engine_views if to == self.engine_address
                  else self._token_views if to == self.token_address
+                 else self._governor_views if to == self.governor_address
                  else None)
         if views is None or data[:4] not in views:
             raise DevnetError(f"no view at {to} for {data[:4].hex()}")
         arg_types, ret_types, fn = views[data[:4]]
         values = abi_decode(arg_types, data[4:])
-        return "0x" + abi_encode(ret_types, fn(values)).hex()
+        try:
+            result = fn(values)
+        except (EngineError, GovernanceError, ValueError) as e:
+            raise DevnetError(f"execution reverted: {e}") from None
+        return "0x" + abi_encode(ret_types, result).hex()
 
     def _eth_get_logs(self, flt: dict) -> list:
         frm = int(flt.get("fromBlock", "0x0"), 16)
@@ -313,6 +423,7 @@ class DevnetNode:
         to = (dec.tx.to or "").lower()
         writes = (self._engine_writes if to == self.engine_address
                   else self._token_writes if to == self.token_address
+                  else self._governor_writes if to == self.governor_address
                   else None)
         sel = dec.tx.data[:4]
         if writes is None or sel not in writes:
@@ -323,7 +434,7 @@ class DevnetNode:
         self._current_txhash = txhash
         try:
             fn(sender, values)
-        except (EngineError, ValueError) as e:
+        except (EngineError, GovernanceError, ValueError) as e:
             # ValueError: TokenLedger's ERC20 reverts
             raise DevnetError(f"execution reverted: {e}") from None
         finally:

@@ -81,8 +81,14 @@ class Governor:
         return keccak256(abi_encode(["uint256", "bytes32"],
                                     [len(actions), desc_hash]))
 
+    def _get(self, pid: bytes) -> Proposal:
+        p = self.proposals.get(pid)
+        if p is None:
+            raise GovernanceError("unknown proposal")
+        return p
+
     def state(self, pid: bytes) -> ProposalState:
-        p = self.proposals[pid]
+        p = self._get(pid)
         if p.executed:
             return ProposalState.EXECUTED
         if p.eta is not None:
@@ -127,7 +133,7 @@ class Governor:
     def cast_vote(self, sender: str, pid: bytes, support: int) -> int:
         """support: 0=against, 1=for, 2=abstain (Bravo-compat)."""
         sender = sender.lower()
-        p = self.proposals[pid]
+        p = self._get(pid)
         if support not in (0, 1, 2):
             raise GovernanceError("invalid vote type")
         if self.state(pid) != ProposalState.ACTIVE:
@@ -149,13 +155,13 @@ class Governor:
     def queue(self, pid: bytes) -> int:
         if self.state(pid) != ProposalState.SUCCEEDED:
             raise GovernanceError("proposal not successful")
-        p = self.proposals[pid]
+        p = self._get(pid)
         p.eta = self.engine.now + TIMELOCK_MIN_DELAY
         self.engine._emit("ProposalQueued", id=pid, eta=p.eta)
         return p.eta
 
     def execute(self, pid: bytes) -> None:
-        p = self.proposals[pid]
+        p = self._get(pid)
         if self.state(pid) != ProposalState.QUEUED:
             raise GovernanceError("proposal not queued")
         if self.engine.now < p.eta:

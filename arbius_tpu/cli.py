@@ -13,6 +13,18 @@ Parity targets from the reference's hardhat task suite
   demo-mine         end-to-end local mine: fake chain + tiny SD-1.5,
                     task → solve → commit → reveal → claim (the §3.2
                     money path, observable in one command)
+  devnet            serve a funded in-process chain over JSON-RPC
+  node-run          mine against a JSON-RPC endpoint (start.ts parity)
+
+Ops verbs against an endpoint (--deployment + --key, signed txs):
+  model-register    model:register — template → on-chain model id
+  validator-stake   validator:stake — approve + deposit to minimum
+  task-submit       submitTask w/ hydrate validation + fee approval
+  task-status       task/solution view (task/[taskid] page data)
+  claim             mining:claimSolution
+  balance           mining:balance
+  timetravel        mine/timetravel — devnet blocks/seconds
+  governance …      delegate/propose/vote/queue/execute/proposal
 
 Run: python -m arbius_tpu.cli <command> [...args]
 """
@@ -183,6 +195,7 @@ def cmd_devnet(args) -> int:
         "rpc_url": f"http://{args.host}:{args.port}",
         "engine_address": node.engine_address,
         "token_address": node.token_address,
+        "governor_address": node.governor_address,
         "chain_id": args.chain_id,
         "model_id": "0x" + mid.hex(),
     }, indent=2))
@@ -194,6 +207,250 @@ def cmd_devnet(args) -> int:
     except KeyboardInterrupt:
         server.shutdown()
     return 0
+
+
+def _rpc_client(args):
+    """Build the signed-tx client every ops verb composes
+    (contract/tasks/index.ts boilerplate: provider + wallet + contracts)."""
+    from arbius_tpu.chain.rpc_client import EngineRpcClient, JsonRpcTransport
+    from arbius_tpu.chain.wallet import Wallet
+    from arbius_tpu.node.config import load_deployment
+
+    dep = load_deployment(open(args.deployment).read())
+    key = args.key or (open(args.key_file).read().strip()
+                       if args.key_file else None)
+    # read-only verbs may omit the key; views don't sign
+    wallet = Wallet.from_hex(key) if key else Wallet.generate()
+    client = EngineRpcClient(JsonRpcTransport(dep.rpc_url),
+                             dep.engine_address, wallet,
+                             chain_id=dep.chain_id)
+    return client, dep
+
+
+def _governor_address(dep) -> str:
+    if dep.governor_address:
+        return dep.governor_address
+    from arbius_tpu.chain.devnet import GOVERNOR_ADDRESS
+
+    return GOVERNOR_ADDRESS
+
+
+def cmd_model_register(args) -> int:
+    """model:register parity (contract/tasks/index.ts:106-143): register a
+    template as an on-chain model and print the derived model id."""
+    from arbius_tpu.l0.abi import abi_encode
+    from arbius_tpu.l0.cid import cid_onchain
+    from arbius_tpu.l0.keccak import keccak256
+    from arbius_tpu.templates.engine import load_template
+
+    client, dep = _rpc_client(args)
+    if args.template_file:
+        template_bytes = open(args.template_file, "rb").read()
+    else:
+        import importlib.resources as res
+
+        load_template(args.template)  # validate it parses
+        template_bytes = (res.files("arbius_tpu.templates") / "data" /
+                          f"{args.template}.json").read_bytes()
+    fee = int(args.fee * 10**18)
+    addr = args.addr or client.wallet.address
+    txhash = client.send("registerModel", [addr, fee, template_bytes])
+    # id = keccak(abi.encode(sender, addr, fee, cid)) — EngineV1.sol:421-426
+    cid = cid_onchain(template_bytes)
+    mid = keccak256(abi_encode(["address", "address", "uint256", "bytes"],
+                               [client.wallet.address, addr, fee, cid]))
+    print(json.dumps({"txhash": txhash, "model_id": "0x" + mid.hex(),
+                      "template_cid": "0x" + cid.hex()}))
+    return 0
+
+
+def cmd_validator_stake(args) -> int:
+    """validator:stake parity (contract/tasks/index.ts:145-157):
+    approve-then-deposit up to the validator minimum (with headroom)."""
+    from arbius_tpu.node.rpc_chain import RpcChain
+
+    client, dep = _rpc_client(args)
+    chain = RpcChain(client, dep.token_address)
+    if args.amount is not None:
+        amount = int(args.amount * 10**18)
+    else:
+        # reference default: minimum * 1.1 headroom against emission drift
+        amount = chain.get_validator_minimum() * 11 // 10
+    chain.validator_deposit(amount)
+    staked = chain.validator_staked()
+    print(json.dumps({"staked_wad": str(staked),
+                      "staked": staked / 10**18}))
+    return 0
+
+
+def cmd_task_submit(args) -> int:
+    """submitTask from the command line (the dapp's generate page /
+    Example/SubmitTask.sol path): hydrate input against the template,
+    submit, and print the taskid recovered from the TaskSubmitted log."""
+    from arbius_tpu.templates.engine import hydrate_input, load_template
+
+    client, dep = _rpc_client(args)
+    raw = json.loads(args.input) if args.input else {}
+    if args.template:
+        hydrate_input(dict(raw), load_template(args.template))  # validate
+    fee = int(args.fee * 10**18)
+    if fee:
+        # self-heal the fee allowance like the dapp's approve-then-submit
+        from arbius_tpu.node.rpc_chain import RpcChain
+
+        chain = RpcChain(client, dep.token_address)
+        if chain.token_allowance(client.engine_address) < fee:
+            client.send_to(dep.token_address, "approve(address,uint256)",
+                           ["address", "uint256"],
+                           [client.engine_address, fee])
+    input_bytes = json.dumps(raw, separators=(",", ":")).encode()
+    from_block = client.block_number()
+    txhash = client.send("submitTask", [
+        args.version, client.wallet.address, args.model, fee, input_bytes])
+    # the id is assigned on-chain (hash chains prevhash) — recover it from
+    # our TaskSubmitted log, like the dapp does from the receipt
+    taskid = None
+    me = client.wallet.address.lower()
+    for lg in client.get_logs("TaskSubmitted", from_block,
+                              client.block_number()):
+        sender = "0x" + lg["topics"][3][-40:]
+        if sender.lower() == me:
+            taskid = lg["topics"][1]
+    print(json.dumps({"txhash": txhash, "taskid": taskid}))
+    return 0
+
+
+def cmd_task_status(args) -> int:
+    """Task / solution view (task/[taskid] page data), through the same
+    RpcChain decode the node mines with (incl. its missing-key sentinels)."""
+    from arbius_tpu.node.rpc_chain import RpcChain
+
+    client, dep = _rpc_client(args)
+    chain = RpcChain(client, dep.token_address)
+    task = chain.get_task(args.taskid)
+    if task is None:
+        print(json.dumps({"taskid": args.taskid, "error": "task not found"}))
+        return 1
+    sol = chain.get_solution(args.taskid)
+    out = {
+        "taskid": args.taskid,
+        "model": "0x" + task.model.hex(), "fee": str(task.fee),
+        "owner": task.owner, "blocktime": task.blocktime,
+        "version": task.version, "input_cid": "0x" + task.cid.hex(),
+        "solution": None,
+    }
+    if sol is not None:
+        out["solution"] = {"validator": sol.validator,
+                           "blocktime": sol.blocktime,
+                           "claimed": sol.claimed,
+                           "cid": "0x" + sol.cid.hex()}
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_claim(args) -> int:
+    """mining:claimSolution parity (contract/tasks/index.ts:87-94)."""
+    client, _ = _rpc_client(args)
+    txhash = client.send("claimSolution", [args.taskid])
+    print(json.dumps({"txhash": txhash}))
+    return 0
+
+
+def cmd_balance(args) -> int:
+    """mining:balance parity (contract/tasks/index.ts:67-74)."""
+    client, dep = _rpc_client(args)
+    from arbius_tpu.l0.abi import abi_decode
+
+    addr = args.address or client.wallet.address
+    bal = abi_decode(["uint256"], client.eth_call_to(
+        dep.token_address, "balanceOf(address)", ["address"], [addr]))[0]
+    print(json.dumps({"address": addr, "balance_wad": str(bal),
+                      "balance": bal / 10**18}))
+    return 0
+
+
+def cmd_timetravel(args) -> int:
+    """timetravel/mine parity (contract/tasks/index.ts:36-47) against a
+    devnet endpoint: advance chain seconds and/or mine blocks."""
+    from arbius_tpu.chain.rpc_client import JsonRpcTransport
+    from arbius_tpu.node.config import load_deployment
+
+    dep = load_deployment(open(args.deployment).read())
+    t = JsonRpcTransport(dep.rpc_url)
+    if args.seconds:
+        t.request("evm_increaseTime", [args.seconds])
+    if args.blocks:
+        t.request("hardhat_mine", [hex(args.blocks)])
+    block = int(t.request("eth_blockNumber", []), 16)
+    print(json.dumps({"block": block}))
+    return 0
+
+
+def _gov_pid(description: str) -> str:
+    """Single-action proposal id: keccak(abi.encode(1, keccak(desc))) —
+    must match Governor._proposal_id."""
+    from arbius_tpu.l0.abi import abi_encode
+    from arbius_tpu.l0.keccak import keccak256
+
+    return "0x" + keccak256(abi_encode(
+        ["uint256", "bytes32"], [1, keccak256(description.encode())])).hex()
+
+
+def cmd_governance(args) -> int:
+    """governance:{delegate,propose,vote,queue,execute,proposal} parity
+    (contract/tasks/index.ts:234-380) against the devnet governor."""
+    from arbius_tpu.l0.abi import abi_decode
+    from arbius_tpu.chain.rpc_client import call_data
+
+    client, dep = _rpc_client(args)
+    gov = _governor_address(dep)
+    verb = args.gov_verb
+    if verb == "delegate":
+        to = args.to or client.wallet.address
+        txhash = client.send_to(dep.token_address, "delegate(address)",
+                                ["address"], [to])
+        print(json.dumps({"txhash": txhash, "delegatee": to}))
+        return 0
+    if verb == "propose":
+        types = args.types.split(",") if args.types else []
+        values = [int(a, 0) if t.startswith("uint") else a
+                  for t, a in zip(types, args.args or [])]
+        calldata = call_data(args.gov_fn, types, values)
+        target = args.target or client.engine_address
+        txhash = client.send_to(
+            gov, "propose(address,uint256,bytes,string)",
+            ["address", "uint256", "bytes", "string"],
+            [target, 0, calldata, args.description])
+        print(json.dumps({"txhash": txhash,
+                          "proposal_id": _gov_pid(args.description)}))
+        return 0
+    if verb == "vote":
+        txhash = client.send_to(gov, "castVote(bytes32,uint8)",
+                                ["bytes32", "uint8"],
+                                [args.pid, args.support])
+        print(json.dumps({"txhash": txhash}))
+        return 0
+    if verb in ("queue", "execute"):
+        txhash = client.send_to(gov, f"{verb}(bytes32)", ["bytes32"],
+                                [args.pid])
+        print(json.dumps({"txhash": txhash}))
+        return 0
+    if verb == "proposal":
+        state = abi_decode(["uint8"], client.eth_call_to(
+            gov, "state(bytes32)", ["bytes32"], [args.pid]))[0]
+        against, for_, abstain = abi_decode(
+            ["uint256", "uint256", "uint256"],
+            client.eth_call_to(gov, "proposalVotes(bytes32)", ["bytes32"],
+                               [args.pid]))
+        from arbius_tpu.chain.governance import ProposalState
+
+        print(json.dumps({
+            "proposal_id": args.pid,
+            "state": ProposalState(state).name,
+            "votes": {"against": str(against), "for": str(for_),
+                      "abstain": str(abstain)}}))
+        return 0
+    raise SystemExit(f"unknown governance verb {verb}")
 
 
 def cmd_node_run(args) -> int:
@@ -274,6 +531,83 @@ def main(argv=None) -> int:
     sp.add_argument("--fund", action="append",
                     help="address to mint 1000 AIUS to (repeatable)")
     sp.set_defaults(fn=cmd_devnet)
+    def add_rpc_args(sp, *, key_required=True):
+        sp.add_argument("--deployment", required=True,
+                        help="deployment constants json")
+        keyg = sp.add_mutually_exclusive_group(required=key_required)
+        keyg.add_argument("--key", help="0x private key")
+        keyg.add_argument("--key-file", help="file holding the private key")
+
+    sp = sub.add_parser("model-register",
+                        help="register a template as an on-chain model")
+    add_rpc_args(sp)
+    tgroup = sp.add_mutually_exclusive_group(required=True)
+    tgroup.add_argument("--template", help="bundled template name")
+    tgroup.add_argument("--template-file", help="path to a template json")
+    sp.add_argument("--fee", type=float, default=0.0, help="model fee (AIUS)")
+    sp.add_argument("--addr", help="model payee address (default: wallet)")
+    sp.set_defaults(fn=cmd_model_register)
+
+    sp = sub.add_parser("validator-stake",
+                        help="approve + deposit validator stake")
+    add_rpc_args(sp)
+    sp.add_argument("--amount", type=float,
+                    help="AIUS to deposit (default: minimum * 1.1)")
+    sp.set_defaults(fn=cmd_validator_stake)
+
+    sp = sub.add_parser("task-submit", help="submit a task on-chain")
+    add_rpc_args(sp)
+    sp.add_argument("--model", required=True, help="0x model id")
+    sp.add_argument("--input", help="input json object")
+    sp.add_argument("--template", help="validate input against template")
+    sp.add_argument("--fee", type=float, default=0.0)
+    sp.add_argument("--version", type=int, default=0)
+    sp.set_defaults(fn=cmd_task_submit)
+
+    sp = sub.add_parser("task-status", help="task/solution view")
+    add_rpc_args(sp, key_required=False)
+    sp.add_argument("taskid")
+    sp.set_defaults(fn=cmd_task_status)
+
+    sp = sub.add_parser("claim", help="claim a solved task's fee+reward")
+    add_rpc_args(sp)
+    sp.add_argument("taskid")
+    sp.set_defaults(fn=cmd_claim)
+
+    sp = sub.add_parser("balance", help="token balance lookup")
+    add_rpc_args(sp, key_required=False)
+    sp.add_argument("--address", help="default: wallet address")
+    sp.set_defaults(fn=cmd_balance)
+
+    sp = sub.add_parser("timetravel",
+                        help="advance devnet time and/or mine blocks")
+    sp.add_argument("--deployment", required=True)
+    sp.add_argument("--seconds", type=int, default=0)
+    sp.add_argument("--blocks", type=int, default=0)
+    sp.set_defaults(fn=cmd_timetravel)
+
+    sp = sub.add_parser("governance", help="DAO verbs against the governor")
+    gsub = sp.add_subparsers(dest="gov_verb", required=True)
+    gp = gsub.add_parser("delegate")
+    add_rpc_args(gp)
+    gp.add_argument("--to", help="delegatee (default: self)")
+    gp = gsub.add_parser("propose")
+    add_rpc_args(gp)
+    gp.add_argument("--target", help="call target (default: engine)")
+    gp.add_argument("--fn", dest="gov_fn", required=True,
+                    help='e.g. "setSolutionMineableRate(bytes32,uint256)"')
+    gp.add_argument("--types", help="comma-separated arg types")
+    gp.add_argument("--args", nargs="*", help="call arguments")
+    gp.add_argument("--description", required=True)
+    for v in ("vote", "queue", "execute", "proposal"):
+        gp = gsub.add_parser(v)
+        add_rpc_args(gp, key_required=(v != "proposal"))
+        gp.add_argument("--pid", required=True, help="0x proposal id")
+        if v == "vote":
+            gp.add_argument("--support", type=int, default=1,
+                            help="0=against 1=for 2=abstain")
+    sp.set_defaults(fn=cmd_governance)
+
     sp = sub.add_parser("node-run")
     sp.add_argument("config", help="MiningConfig.json path")
     sp.add_argument("--deployment", required=True,

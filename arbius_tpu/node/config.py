@@ -127,6 +127,7 @@ class DeploymentConfig:
     token_address: str
     chain_id: int
     start_block: int = 0          # poll_events starts here
+    governor_address: str = ""    # optional: governance verbs' target
 
 
 def load_deployment(raw: str | dict) -> DeploymentConfig:

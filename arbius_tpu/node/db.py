@@ -230,6 +230,31 @@ class NodeDB:
                 "FROM tasks t LEFT JOIN solutions s ON s.taskid = t.id "
                 "ORDER BY t.rowid DESC LIMIT ?", (limit,)).fetchall()
 
+    def task_view(self, taskid: str) -> sqlite3.Row | None:
+        """One task + solution join row (the task page's data source)."""
+        with self._lock:
+            return self._conn.execute(
+                "SELECT t.id, t.modelid, t.fee, t.address, t.blocktime, "
+                "s.validator, s.cid, s.claimed, "
+                "(SELECT 1 FROM invalid_tasks i WHERE i.taskid = t.id) inv "
+                "FROM tasks t LEFT JOIN solutions s ON s.taskid = t.id "
+                "WHERE t.id = ?", (taskid,)).fetchone()
+
+    def tasks_by_address(self, address: str,
+                         limit: int = 100) -> list[sqlite3.Row]:
+        """Address history: tasks submitted by OR solved by `address`
+        (the reference dapp's history/[address] page)."""
+        addr = address.lower()
+        with self._lock:
+            return self._conn.execute(
+                "SELECT t.id, t.modelid, t.fee, t.address, t.blocktime, "
+                "s.validator, s.cid, s.claimed, "
+                "(SELECT 1 FROM invalid_tasks i WHERE i.taskid = t.id) inv "
+                "FROM tasks t LEFT JOIN solutions s ON s.taskid = t.id "
+                "WHERE lower(t.address) = ? OR lower(s.validator) = ? "
+                "ORDER BY t.rowid DESC LIMIT ?",
+                (addr, addr, limit)).fetchall()
+
     def store_vote(self, taskid: str, validator: str, yea: bool) -> None:
         with self._lock:
             self._conn.execute(

@@ -36,15 +36,22 @@ class ControlRPC:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_html(self, html: str):
+                body = html.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/" or self.path == "/explorer":
-                    body = outer.explorer_html().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/html; charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send_html(outer.explorer_html())
+                elif self.path.startswith("/task/"):
+                    self._send_html(outer.task_html(self.path[len("/task/"):]))
+                elif self.path.startswith("/history/"):
+                    self._send_html(
+                        outer.history_html(self.path[len("/history/"):]))
                 elif self.path == "/api/tasks":
                     self._send(200, outer.recent_tasks())
                 elif self.path == "/api/jobs/get":
@@ -78,6 +85,16 @@ class ControlRPC:
                         self._send(400, {"error": "method required"})
                         return
                     self._send(200, {"id": job_id})
+                elif self.path == "/api/tasks/submit":
+                    try:
+                        result = outer.submit_task(body)
+                    except Exception as e:  # noqa: BLE001 — a form submit
+                        # must always get a JSON response: bad input
+                        # (KeyError/ValueError/TypeError), chain reverts
+                        # (EngineError), endpoint failures (ChainRpcError)
+                        self._send(400, {"error": str(e) or repr(e)})
+                        return
+                    self._send(200, result)
                 elif self.path == "/api/jobs/delete":
                     try:
                         outer.node.db.delete_job(int(body["id"]))
@@ -142,14 +159,139 @@ class ControlRPC:
     def recent_tasks(self, limit: int = 50) -> list[dict]:
         """Task/solution view — the explorer's data source (the reference
         website's explorer + task/[taskid] pages, `website/src/pages`)."""
-        rows = self.node.db.recent_tasks(limit)
-        return [{
+        return [self._row_to_view(r)
+                for r in self.node.db.recent_tasks(limit)]
+
+    def submit_task(self, body: dict) -> dict:
+        """Dapp generate-page parity (`website/src/pages/generate.tsx`):
+        hydrate-validate the input against the model's template and submit
+        the task through the node's chain facade (the node's wallet signs
+        when the facade is RpcChain)."""
+        from arbius_tpu.templates.engine import hydrate_input
+
+        model_id = body["model"]
+        m = self.node.registry.get(model_id)
+        if m is None:
+            raise ValueError(f"unknown model {model_id}")
+        raw = body.get("input", {})
+        if not isinstance(raw, dict):
+            raise ValueError("input must be an object")
+        hydrate_input(dict(raw), m.template)  # reject before paying the fee
+        fee = int(body.get("fee") or 0)  # str or int; wad > 2^53 arrives str
+        input_bytes = json.dumps(raw, separators=(",", ":")).encode()
+        taskid = self.node.chain.submit_task(0, self.node.chain.address,
+                                             model_id, fee, input_bytes)
+        return {"taskid": taskid or None, "submitted": True}
+
+    _PAGE_STYLE = (
+        "body{font-family:system-ui;margin:2rem;max-width:70rem}"
+        "table{border-collapse:collapse;width:100%}"
+        "td,th{border:1px solid #ccc;padding:.3rem .5rem;text-align:left}"
+        "code{font-size:.85em}img,video{max-width:100%}"
+        "form{margin:.5rem 0}textarea{width:100%;font-family:monospace}")
+
+    def _task_status(self, t: dict) -> str:
+        return ("invalid" if t["invalid"] else
+                "claimed" if t["claimed"] else
+                "solved" if t["solution_validator"] else "pending")
+
+    def _row_to_view(self, r) -> dict:
+        return {
             "taskid": r["id"], "model": r["modelid"], "fee": r["fee"],
             "owner": r["address"], "blocktime": r["blocktime"],
             "solution_validator": r["validator"], "solution_cid": r["cid"],
             "claimed": bool(r["claimed"]) if r["claimed"] is not None else None,
             "invalid": bool(r["inv"]),
-        } for r in rows]
+        }
+
+    def task_html(self, taskid: str) -> str:
+        """Task page (`website/src/pages/task/[taskid].tsx` parity):
+        details + hydrated input + outputs rendered by the template's
+        declared `output.type` from the node's /ipfs gateway."""
+        import html as _html
+
+        row = self.node.db.task_view(taskid)
+        if row is None:
+            return (f"<!doctype html><html><body><h1>task not found</h1>"
+                    f"<code>{_html.escape(taskid)}</code></body></html>")
+        sol = self._row_to_view(row)
+        status = self._task_status(sol)
+        inp = self.node.db.get_task_input(taskid)
+        m = self.node.registry.get(row["modelid"])
+        outputs_html = ""
+        cid_hex = sol["solution_cid"] if sol else None
+        if m is not None and cid_hex:
+            try:
+                from arbius_tpu.node.store import cid_b58
+
+                b58 = cid_b58(cid_hex)
+            except ValueError:
+                b58 = None
+            store = getattr(self.node, "store", None)
+            if b58 and store is not None and store.has(b58):
+                parts = []
+                for out in m.template.outputs:
+                    name = _html.escape(out.filename)
+                    src = f"/ipfs/{b58}/{name}"
+                    if out.type == "image":
+                        parts.append(f"<figure><img src='{src}' alt='{name}'>"
+                                     f"<figcaption>{name}</figcaption>"
+                                     "</figure>")
+                    elif out.type == "video":
+                        parts.append(f"<figure><video controls src='{src}'>"
+                                     f"</video><figcaption>{name}"
+                                     "</figcaption></figure>")
+                    else:  # text / audio / unknown: link to the bytes
+                        parts.append(f"<p><a href='{src}'>{name}</a></p>")
+                outputs_html = "<h2>Outputs</h2>" + "".join(parts)
+            elif b58:
+                outputs_html = (f"<h2>Outputs</h2><p>cid <code>{b58}"
+                                "</code> not in local store</p>")
+        input_html = ""
+        if inp:
+            input_html = ("<h2>Input</h2><pre>" + _html.escape(
+                json.dumps(inp, indent=2, sort_keys=True)) + "</pre>")
+        owner = row["address"] or ""
+        val = (sol["solution_validator"] or "") if sol else ""
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>task {taskid[:10]}…</title>"
+            f"<style>{self._PAGE_STYLE}</style></head><body>"
+            f"<p><a href='/'>← explorer</a></p>"
+            f"<h1>Task <code>{_html.escape(taskid)}</code></h1><ul>"
+            f"<li>status: <b>{status}</b></li>"
+            f"<li>model: <code>{_html.escape(row['modelid'] or '')}</code></li>"
+            f"<li>fee: {row['fee']}</li>"
+            f"<li>owner: <a href='/history/{_html.escape(owner)}'>"
+            f"<code>{_html.escape(owner)}</code></a></li>"
+            + (f"<li>solver: <a href='/history/{_html.escape(val)}'>"
+               f"<code>{_html.escape(val)}</code></a></li>" if val else "")
+            + f"</ul>{input_html}{outputs_html}</body></html>")
+
+    def history_html(self, address: str) -> str:
+        """Address history (`website/src/pages/history/[address].tsx`
+        parity): tasks submitted by or solved by the address."""
+        import html as _html
+
+        addr = _html.escape(address)
+        rows = [self._row_to_view(r)
+                for r in self.node.db.tasks_by_address(address)]
+        body = "".join(
+            f"<tr><td><a href='/task/{t['taskid']}'>"
+            f"<code>{t['taskid'][:18]}…</code></a></td>"
+            f"<td>{'submitted' if (t['owner'] or '').lower() == address.lower() else 'solved'}</td>"
+            f"<td>{t['fee']}</td>"
+            f"<td>{self._task_status(t)}</td></tr>"
+            for t in rows)
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>history {addr[:10]}…</title>"
+            f"<style>{self._PAGE_STYLE}</style></head><body>"
+            "<p><a href='/'>← explorer</a></p>"
+            f"<h1>History <code>{addr}</code></h1>"
+            f"<p>{len(rows)} task(s)</p>"
+            "<table><tr><th>task</th><th>role</th><th>fee</th>"
+            f"<th>status</th></tr>{body}</table></body></html>")
 
     def explorer_html(self) -> str:
         """Single-page explorer (L5 parity: the reference ships a Next.js
@@ -171,22 +313,41 @@ class ControlRPC:
             return f"<code>{b58[:16]}…</code>"
 
         rows = "".join(
-            f"<tr><td><code>{t['taskid'][:18]}…</code></td>"
+            f"<tr><td><a href='/task/{t['taskid']}'>"
+            f"<code>{t['taskid'][:18]}…</code></a></td>"
             f"<td><code>{(t['model'] or '')[:14]}…</code></td>"
             f"<td>{t['fee']}</td>"
-            f"<td>{'invalid' if t['invalid'] else ('claimed' if t['claimed'] else ('solved' if t['solution_validator'] else 'pending'))}</td>"
+            f"<td>{self._task_status(t)}</td>"
             f"<td>{cid_cell(t['solution_cid'])}</td></tr>"
             for t in self.recent_tasks())
         stats = "".join(f"<li>{k}: <b>{v}</b></li>" for k, v in m.items())
+        options = "".join(f"<option value='{mid}'>{mid[:18]}…</option>"
+                          for mid in self.node.registry.ids())
+        addr = self.node.chain.address
+        # generate.tsx parity: template-driven submit form, posted to
+        # /api/tasks/submit and signed by the node's wallet
+        form = (
+            "<h2>Submit task</h2>"
+            f"<form onsubmit=\"fetch('/api/tasks/submit',{{method:'POST',"
+            "body:JSON.stringify({model:this.model.value,"
+            "fee:this.fee.value||'0',"  # string: wad > 2^53 survives JSON
+            "input:JSON.parse(this.input.value)})})"
+            ".then(r=>r.json()).then(j=>{document.getElementById('subres')"
+            ".textContent=JSON.stringify(j);setTimeout(()=>location.reload()"
+            ",800)});return false\">"
+            f"<label>model <select name='model'>{options}</select></label> "
+            "<label>fee (wad) <input name='fee' value='0' size='8'></label>"
+            "<br><textarea name='input' rows='4'>"
+            '{"prompt": "arbius test cat", "negative_prompt": ""}'
+            "</textarea><br><button>submit</button> "
+            "<span id='subres'></span></form>")
         return (
             "<!doctype html><html><head><meta charset='utf-8'>"
-            "<title>arbius-tpu node</title><style>"
-            "body{font-family:system-ui;margin:2rem;max-width:70rem}"
-            "table{border-collapse:collapse;width:100%}"
-            "td,th{border:1px solid #ccc;padding:.3rem .5rem;text-align:left}"
-            "code{font-size:.85em}</style></head><body>"
-            f"<h1>arbius-tpu node <small>{self.node.chain.address}</small></h1>"
-            f"<h2>Metrics</h2><ul>{stats}</ul>"
+            "<title>arbius-tpu node</title>"
+            f"<style>{self._PAGE_STYLE}</style></head><body>"
+            f"<h1>arbius-tpu node <small><a href='/history/{addr}'>"
+            f"{addr}</a></small></h1>"
+            f"<h2>Metrics</h2><ul>{stats}</ul>{form}"
             "<h2>Recent tasks</h2><table><tr><th>task</th><th>model</th>"
             f"<th>fee</th><th>status</th><th>solution cid</th></tr>{rows}"
             "</table></body></html>")

@@ -1,0 +1,207 @@
+"""Ops CLI verbs against a served devnet — the hardhat-task parity layer
+(`contract/tasks/index.ts:12-465`): register → stake → submit → solve →
+claim, and the full governance lifecycle, all through `arbius_tpu.cli`
+with real signed transactions over HTTP JSON-RPC.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from arbius_tpu.chain import Engine, TokenLedger, WAD, Wallet
+from arbius_tpu.chain.devnet import DevnetNode
+from arbius_tpu.chain.governance import (
+    TIMELOCK_MIN_DELAY,
+    VOTING_DELAY,
+    VOTING_PERIOD,
+)
+from arbius_tpu.chain.rpc_client import EngineRpcClient, JsonRpcTransport
+from arbius_tpu.cli import main
+from arbius_tpu.l0.cid import cid_hex, cid_of_solution_files
+from arbius_tpu.l0.commitment import generate_commitment
+
+CHAIN_ID = 31337
+
+
+@pytest.fixture()
+def world(tmp_path):
+    operator = Wallet.generate()
+    miner = Wallet.generate()
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=1000)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    tok.mint(operator.address.lower(), 100_000 * WAD)
+    tok.mint(miner.address.lower(), 10_000 * WAD)
+    dev = DevnetNode(eng, chain_id=CHAIN_ID)
+    server = dev.serve("127.0.0.1", 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    dep_path = tmp_path / "deployment.json"
+    dep_path.write_text(json.dumps({
+        "rpc_url": f"http://127.0.0.1:{port}",
+        "engine_address": dev.engine_address,
+        "token_address": dev.token_address,
+        "governor_address": dev.governor_address,
+        "chain_id": CHAIN_ID,
+    }))
+    try:
+        yield eng, dev, operator, miner, str(dep_path)
+    finally:
+        server.shutdown()
+
+
+def run_cli(capsys, argv) -> dict:
+    assert main(argv) == 0
+    return json.loads(capsys.readouterr().out.strip())
+
+
+def test_register_stake_submit_solve_claim(world, capsys, tmp_path):
+    eng, dev, operator, miner, dep = world
+    base = ["--deployment", dep]
+
+    # model:register — bundled template, derived id matches the engine's
+    reg = run_cli(capsys, ["model-register", *base, "--key", "0x" + operator.private_key.hex(),
+                           "--template", "anythingv3"])
+    mid = reg["model_id"]
+    assert bytes.fromhex(mid[2:]) in eng.models
+
+    # validator:stake — approve + deposit to minimum*1.1
+    st = run_cli(capsys, ["validator-stake", *base, "--key", "0x" + miner.private_key.hex()])
+    assert int(st["staked_wad"]) >= eng.get_validator_minimum()
+
+    # task-submit — hydrate-validated input, taskid from the log
+    sub = run_cli(capsys, ["task-submit", *base, "--key", "0x" + operator.private_key.hex(),
+                           "--model", mid, "--template", "anythingv3",
+                           "--fee", "10",
+                           "--input", json.dumps({
+                               "prompt": "ops cli", "negative_prompt": ""})])
+    taskid = sub["taskid"]
+    assert taskid and bytes.fromhex(taskid[2:]) in eng.tasks
+
+    # solve out-of-band through the same signed-tx client (the node's job;
+    # here the CLI test only needs a claimable solution on-chain)
+    client = EngineRpcClient(JsonRpcTransport(dep_url(dep)),
+                             dev.engine_address, miner, chain_id=CHAIN_ID)
+    cid = cid_hex(cid_of_solution_files({"out-1.png": b"\x89PNGfake"}))
+    commitment = generate_commitment(miner.address, taskid, cid)
+    client.send("signalCommitment", [commitment])
+    run_cli(capsys, ["timetravel", "--deployment", dep, "--blocks", "1"])
+    client.send("submitSolution", [taskid, cid])
+
+    status = run_cli(capsys, ["task-status", *base, taskid])
+    assert status["solution"]["validator"] == miner.address.lower()
+    assert status["solution"]["cid"] == cid
+    assert status["solution"]["claimed"] is False
+
+    # claim is time-gated (EngineV1.sol:255: minClaimSolutionTime=2000)
+    run_cli(capsys, ["timetravel", "--deployment", dep, "--seconds", "2120",
+                     "--blocks", "1"])
+    bal0 = run_cli(capsys, ["balance", *base, "--key", "0x" + miner.private_key.hex()])
+    run_cli(capsys, ["claim", *base, "--key", "0x" + miner.private_key.hex(), taskid])
+    status = run_cli(capsys, ["task-status", *base, taskid])
+    assert status["solution"]["claimed"] is True
+    bal1 = run_cli(capsys, ["balance", *base, "--key", "0x" + miner.private_key.hex()])
+    assert int(bal1["balance_wad"]) > int(bal0["balance_wad"])  # emission
+
+
+def test_governance_lifecycle(world, capsys):
+    eng, dev, operator, miner, dep = world
+    base = ["--deployment", dep, "--key", "0x" + operator.private_key.hex()]
+
+    reg = run_cli(capsys, ["model-register", "--deployment", dep,
+                           "--key", "0x" + operator.private_key.hex(),
+                           "--template", "kandinsky2"])
+    mid = reg["model_id"]
+    rate = 10**18
+
+    run_cli(capsys, ["governance", "delegate", *base])
+    run_cli(capsys, ["timetravel", "--deployment", dep, "--blocks", "1"])
+
+    prop = run_cli(capsys, [
+        "governance", "propose", *base,
+        "--fn", "setSolutionMineableRate(bytes32,uint256)",
+        "--types", "bytes32,uint256", "--args", mid, str(rate),
+        "--description", "make kandinsky2 mineable"])
+    pid = prop["proposal_id"]
+
+    view = run_cli(capsys, ["governance", "proposal", "--deployment", dep,
+                            "--pid", pid])
+    assert view["state"] == "PENDING"
+
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_DELAY + 1)])
+    run_cli(capsys, ["governance", "vote", *base, "--pid", pid,
+                     "--support", "1"])
+    view = run_cli(capsys, ["governance", "proposal", "--deployment", dep,
+                            "--pid", pid])
+    assert int(view["votes"]["for"]) >= 100_000 * WAD
+
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_PERIOD + 1)])
+    run_cli(capsys, ["governance", "queue", *base, "--pid", pid])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--seconds", str(TIMELOCK_MIN_DELAY + 1), "--blocks", "1"])
+    run_cli(capsys, ["governance", "execute", *base, "--pid", pid])
+
+    assert eng.models[bytes.fromhex(mid[2:])].rate == rate
+    view = run_cli(capsys, ["governance", "proposal", "--deployment", dep,
+                            "--pid", pid])
+    assert view["state"] == "EXECUTED"
+
+
+def test_unauthorized_governance_call_refused(world, capsys):
+    """Proposals may only call the governance-gated admin surface."""
+    eng, dev, operator, miner, dep = world
+    run_cli(capsys, ["governance", "delegate", "--deployment", dep,
+                     "--key", "0x" + operator.private_key.hex()])
+    run_cli(capsys, ["timetravel", "--deployment", dep, "--blocks", "1"])
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    with pytest.raises(RpcError, match="no governance-executable call"):
+        main(["governance", "propose", "--deployment", dep,
+              "--key", "0x" + operator.private_key.hex(),
+              "--fn", "validatorDeposit(address,uint256)",
+              "--types", "address,uint256",
+              "--args", operator.address, "1",
+              "--description", "sneaky"])
+
+
+def test_unknown_proposal_reverts_cleanly(world, capsys):
+    """A typo'd pid must surface as a revert, not a raw KeyError."""
+    eng, dev, operator, miner, dep = world
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    with pytest.raises(RpcError, match="unknown proposal"):
+        main(["governance", "vote", "--deployment", dep,
+              "--key", "0x" + operator.private_key.hex(),
+              "--pid", "0x" + "99" * 32])
+    with pytest.raises(RpcError, match="unknown proposal"):
+        main(["governance", "proposal", "--deployment", dep,
+              "--pid", "0x" + "99" * 32])
+
+
+def test_evm_mine_timestamp_semantics(world):
+    """evm_mine's optional param is a block TIMESTAMP (ganache/hardhat),
+    not a count — the count batch lives under hardhat_mine."""
+    eng, dev, operator, miner, dep = world
+    before_block, before_now = eng.block_number, eng.now
+    dev.request("evm_mine", [hex(before_now + 500)])
+    assert eng.block_number == before_block + 1
+    assert eng.now >= before_now + 500
+    dev.request("hardhat_mine", [hex(10)])
+    assert eng.block_number == before_block + 11
+
+
+def test_task_status_unknown_task_errors(world, capsys):
+    eng, dev, operator, miner, dep = world
+    assert main(["task-status", "--deployment", dep,
+                 "0x" + "42" * 32]) == 1
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["error"] == "task not found"
+
+
+def dep_url(dep_path: str) -> str:
+    return json.loads(open(dep_path).read())["rpc_url"]
