@@ -64,6 +64,10 @@ EVENT_ABI = {
         ("yea", "bool", False)]),
     "VersionChanged": ("VersionChanged(uint256)", [
         ("version", "uint256", False)]),
+    "PausedChanged": ("PausedChanged(bool)", [
+        ("paused", "bool", False)]),
+    "ProposalCreated": ("ProposalCreated(bytes32,address)", [
+        ("id", "bytes32", True), ("proposer", "address", True)]),
 }
 
 EVENT_TOPIC0 = {name: keccak256(sig.encode())
@@ -165,7 +169,7 @@ class DevnetNode:
                 ["bytes32", "uint256"],
                 lambda v: eng.set_solution_mineable_rate(v[0], v[1])),
             (self.engine_address, _selector("setPaused(bool)")): (
-                ["bool"], lambda v: setattr(eng, "paused", v[0])),
+                ["bool"], lambda v: eng.set_paused(v[0])),
         }
 
         def _gov_action(target: str, value: int, calldata: bytes):
@@ -182,7 +186,12 @@ class DevnetNode:
 
         def _propose(s, v):
             action = _gov_action(v[0], v[1], v[2])
-            return self.governor.propose(s, [action], v[3])
+            # bind the id to the action content like OZ (targets, values,
+            # calldatas): same-description proposals with different
+            # calldata must not collide
+            digest = keccak256(abi_encode(
+                ["address", "uint256", "bytes"], [v[0], v[1], v[2]]))
+            return self.governor.propose(s, [action], v[3], digest=digest)
 
         self._governor_writes = {
             _selector("propose(address,uint256,bytes,string)"): (

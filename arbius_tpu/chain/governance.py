@@ -71,13 +71,19 @@ class Governor:
         self.proposals_created: list[bytes] = []
 
     # -- id & state ------------------------------------------------------
-    def _proposal_id(self, actions, description: str) -> bytes:
-        """OZ hashes (targets, values, calldatas, descriptionHash); Python
-        callables have no canonical calldata, so the id binds the action
-        COUNT + description hash. Deviation from OZ: two proposals with
-        different actions but identical description and action count
-        collide — descriptions are required to be unique per proposal."""
+    def _proposal_id(self, actions, description: str,
+                     digest: bytes | None = None) -> bytes:
+        """OZ hashes (targets, values, calldatas, descriptionHash). Python
+        callables have no canonical calldata, so callers that DO have
+        calldata (the devnet's propose(target,value,calldata,description)
+        surface) pass its keccak as `digest`, restoring the OZ property
+        that different actions under the same description get distinct
+        ids. Without a digest the id binds action COUNT + description
+        hash only — then descriptions must be unique per proposal."""
         desc_hash = keccak256(description.encode())
+        if digest is not None:
+            return keccak256(abi_encode(["bytes32", "bytes32"],
+                                        [digest, desc_hash]))
         return keccak256(abi_encode(["uint256", "bytes32"],
                                     [len(actions), desc_hash]))
 
@@ -110,12 +116,12 @@ class Governor:
 
     # -- lifecycle -------------------------------------------------------
     def propose(self, sender: str, actions: list[Callable[[], None]],
-                description: str) -> bytes:
+                description: str, digest: bytes | None = None) -> bytes:
         sender = sender.lower()
         if self.token.get_past_votes(
                 sender, self.engine.block_number - 1) < PROPOSAL_THRESHOLD:
             raise GovernanceError("proposer votes below proposal threshold")
-        pid = self._proposal_id(actions, description)
+        pid = self._proposal_id(actions, description, digest)
         if pid in self.proposals:
             raise GovernanceError("proposal already exists")
         block = self.engine.block_number
@@ -166,7 +172,11 @@ class Governor:
             raise GovernanceError("proposal not queued")
         if self.engine.now < p.eta:
             raise GovernanceError("timelock delay not elapsed")
-        p.executed = True
+        # run the actions BEFORE marking executed: there is no EVM-style
+        # tx rollback here, so a reverting action must leave the proposal
+        # QUEUED (re-executable after the cause is fixed), not permanently
+        # EXECUTED-with-no-effect
         for action in p.actions:
             action()
+        p.executed = True
         self.engine._emit("ProposalExecuted", id=pid)

@@ -63,6 +63,8 @@ ENGINE_EVENTS = {
     "ContestationSubmitted": "ContestationSubmitted(address,bytes32)",
     "SignalCommitment": "SignalCommitment(address,bytes32)",
     "VersionChanged": "VersionChanged(uint256)",
+    "PausedChanged": "PausedChanged(bool)",
+    "ProposalCreated": "ProposalCreated(bytes32,address)",
 }
 
 

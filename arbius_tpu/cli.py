@@ -32,7 +32,38 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
+
+
+def _wad(amount: str) -> int:
+    """Exact decimal AIUS string → wei wad (parseEther semantics). Float
+    would drift off-by-wei for most decimal inputs — e.g. int(1.1*10**18)
+    is not 11*10**17 — and a drifted fee reverts submitTask or skews the
+    registered model id."""
+    from decimal import Decimal, InvalidOperation
+
+    try:
+        wad = Decimal(amount) * 10**18
+    except InvalidOperation:
+        raise SystemExit(f"bad AIUS amount {amount!r}")
+    if wad != int(wad):
+        raise SystemExit(f"{amount!r} has more than 18 decimal places")
+    return int(wad)
+
+
+def _abi_cli_value(typ: str, arg: str):
+    """CLI string literal → abi_encode-ready value for one static type."""
+    if typ.startswith(("uint", "int")):
+        return int(arg, 0)
+    if typ == "bool":
+        low = arg.lower()
+        if low in ("true", "1"):
+            return 1
+        if low in ("false", "0"):
+            return 0
+        raise SystemExit(f"bad bool literal {arg!r}")
+    return arg
 
 
 def cmd_wallet_gen(args) -> int:
@@ -103,7 +134,7 @@ def cmd_emission(args) -> int:
     from arbius_tpu.chain.fixedpoint import WAD, diff_mul, reward, target_ts
 
     t = args.t
-    ts = int(args.supply * WAD)
+    ts = _wad(args.supply)
     out = {"t": t, "targetTs": target_ts(t) / WAD}
     if ts > 0 and t > 0:
         out["diffMul"] = diff_mul(t, ts) / WAD
@@ -241,18 +272,15 @@ def cmd_model_register(args) -> int:
     from arbius_tpu.l0.abi import abi_encode
     from arbius_tpu.l0.cid import cid_onchain
     from arbius_tpu.l0.keccak import keccak256
-    from arbius_tpu.templates.engine import load_template
+    from arbius_tpu.templates.engine import load_template, load_template_bytes
 
     client, dep = _rpc_client(args)
     if args.template_file:
         template_bytes = open(args.template_file, "rb").read()
     else:
-        import importlib.resources as res
-
         load_template(args.template)  # validate it parses
-        template_bytes = (res.files("arbius_tpu.templates") / "data" /
-                          f"{args.template}.json").read_bytes()
-    fee = int(args.fee * 10**18)
+        template_bytes = load_template_bytes(args.template)
+    fee = _wad(args.fee)
     addr = args.addr or client.wallet.address
     txhash = client.send("registerModel", [addr, fee, template_bytes])
     # id = keccak(abi.encode(sender, addr, fee, cid)) — EngineV1.sol:421-426
@@ -272,7 +300,7 @@ def cmd_validator_stake(args) -> int:
     client, dep = _rpc_client(args)
     chain = RpcChain(client, dep.token_address)
     if args.amount is not None:
-        amount = int(args.amount * 10**18)
+        amount = _wad(args.amount)
     else:
         # reference default: minimum * 1.1 headroom against emission drift
         amount = chain.get_validator_minimum() * 11 // 10
@@ -293,16 +321,12 @@ def cmd_task_submit(args) -> int:
     raw = json.loads(args.input) if args.input else {}
     if args.template:
         hydrate_input(dict(raw), load_template(args.template))  # validate
-    fee = int(args.fee * 10**18)
+    fee = _wad(args.fee)
     if fee:
         # self-heal the fee allowance like the dapp's approve-then-submit
         from arbius_tpu.node.rpc_chain import RpcChain
 
-        chain = RpcChain(client, dep.token_address)
-        if chain.token_allowance(client.engine_address) < fee:
-            client.send_to(dep.token_address, "approve(address,uint256)",
-                           ["address", "uint256"],
-                           [client.engine_address, fee])
+        RpcChain(client, dep.token_address).ensure_fee_allowance(fee)
     input_bytes = json.dumps(raw, separators=(",", ":")).encode()
     from_block = client.block_number()
     txhash = client.send("submitTask", [
@@ -386,16 +410,6 @@ def cmd_timetravel(args) -> int:
     return 0
 
 
-def _gov_pid(description: str) -> str:
-    """Single-action proposal id: keccak(abi.encode(1, keccak(desc))) —
-    must match Governor._proposal_id."""
-    from arbius_tpu.l0.abi import abi_encode
-    from arbius_tpu.l0.keccak import keccak256
-
-    return "0x" + keccak256(abi_encode(
-        ["uint256", "bytes32"], [1, keccak256(description.encode())])).hex()
-
-
 def cmd_governance(args) -> int:
     """governance:{delegate,propose,vote,queue,execute,proposal} parity
     (contract/tasks/index.ts:234-380) against the devnet governor."""
@@ -412,17 +426,34 @@ def cmd_governance(args) -> int:
         print(json.dumps({"txhash": txhash, "delegatee": to}))
         return 0
     if verb == "propose":
-        types = args.types.split(",") if args.types else []
-        values = [int(a, 0) if t.startswith("uint") else a
-                  for t, a in zip(types, args.args or [])]
+        # arg types come from the --fn signature itself (the selector is
+        # derived from the same string, so they can never disagree)
+        m = re.fullmatch(r"[A-Za-z_]\w*\(([^()]*)\)", args.gov_fn)
+        if m is None:
+            raise SystemExit(f"bad function signature {args.gov_fn!r}")
+        types = [t for t in m.group(1).split(",") if t]
+        given = args.args or []
+        if len(given) != len(types):
+            raise SystemExit(f"{args.gov_fn} takes {len(types)} arg(s), "
+                             f"got {len(given)}")
+        values = [_abi_cli_value(t, a) for t, a in zip(types, given)]
         calldata = call_data(args.gov_fn, types, values)
         target = args.target or client.engine_address
+        from_block = client.block_number()
         txhash = client.send_to(
             gov, "propose(address,uint256,bytes,string)",
             ["address", "uint256", "bytes", "string"],
             [target, 0, calldata, args.description])
-        print(json.dumps({"txhash": txhash,
-                          "proposal_id": _gov_pid(args.description)}))
+        # recover the id from our ProposalCreated log rather than
+        # re-deriving Governor._proposal_id client-side (same pattern as
+        # task-submit: the chain is the source of truth for assigned ids)
+        pid = None
+        me = client.wallet.address.lower()
+        for lg in client.get_logs("ProposalCreated", from_block,
+                                  client.block_number()):
+            if ("0x" + lg["topics"][2][-40:]).lower() == me:
+                pid = lg["topics"][1]
+        print(json.dumps({"txhash": txhash, "proposal_id": pid}))
         return 0
     if verb == "vote":
         txhash = client.send_to(gov, "castVote(bytes32,uint8)",
@@ -518,7 +549,7 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_commitment)
     sp = sub.add_parser("emission")
     sp.add_argument("--t", type=int, default=31536000)
-    sp.add_argument("--supply", type=float, default=100000.0)
+    sp.add_argument("--supply", default="100000")
     sp.set_defaults(fn=cmd_emission)
     sp = sub.add_parser("demo-mine")
     sp.add_argument("--prompt", default="arbius test cat")
@@ -544,14 +575,14 @@ def main(argv=None) -> int:
     tgroup = sp.add_mutually_exclusive_group(required=True)
     tgroup.add_argument("--template", help="bundled template name")
     tgroup.add_argument("--template-file", help="path to a template json")
-    sp.add_argument("--fee", type=float, default=0.0, help="model fee (AIUS)")
+    sp.add_argument("--fee", default="0", help="model fee (AIUS)")
     sp.add_argument("--addr", help="model payee address (default: wallet)")
     sp.set_defaults(fn=cmd_model_register)
 
     sp = sub.add_parser("validator-stake",
                         help="approve + deposit validator stake")
     add_rpc_args(sp)
-    sp.add_argument("--amount", type=float,
+    sp.add_argument("--amount",
                     help="AIUS to deposit (default: minimum * 1.1)")
     sp.set_defaults(fn=cmd_validator_stake)
 
@@ -560,7 +591,7 @@ def main(argv=None) -> int:
     sp.add_argument("--model", required=True, help="0x model id")
     sp.add_argument("--input", help="input json object")
     sp.add_argument("--template", help="validate input against template")
-    sp.add_argument("--fee", type=float, default=0.0)
+    sp.add_argument("--fee", default="0")
     sp.add_argument("--version", type=int, default=0)
     sp.set_defaults(fn=cmd_task_submit)
 
@@ -596,7 +627,6 @@ def main(argv=None) -> int:
     gp.add_argument("--target", help="call target (default: engine)")
     gp.add_argument("--fn", dest="gov_fn", required=True,
                     help='e.g. "setSolutionMineableRate(bytes32,uint256)"')
-    gp.add_argument("--types", help="comma-separated arg types")
     gp.add_argument("--args", nargs="*", help="call arguments")
     gp.add_argument("--description", required=True)
     for v in ("vote", "queue", "execute", "proposal"):

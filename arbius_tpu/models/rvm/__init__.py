@@ -1,11 +1,18 @@
-"""Robust Video Matting family: recurrent ConvGRU matting
-(`templates/robust_video_matting.json` model class)."""
-from arbius_tpu.models.rvm.model import ConvGRUCell, RVMConfig, RVMStep
+"""Robust Video Matting family: the published RVM recurrent matting
+network (`templates/robust_video_matting.json` model class)."""
+from arbius_tpu.models.rvm.convert import convert_rvm, rvm_key_for
+from arbius_tpu.models.rvm.model import (
+    MOBILENETV3_LARGE_ROWS,
+    ConvGRU,
+    MattingStep,
+    RVMConfig,
+)
 from arbius_tpu.models.rvm.pipeline import (
     OUTPUT_TYPES,
     RVMPipeline,
     RVMPipelineConfig,
 )
 
-__all__ = ["ConvGRUCell", "OUTPUT_TYPES", "RVMConfig", "RVMPipeline",
-           "RVMPipelineConfig", "RVMStep"]
+__all__ = ["ConvGRU", "MOBILENETV3_LARGE_ROWS", "MattingStep",
+           "OUTPUT_TYPES", "RVMConfig", "RVMPipeline", "RVMPipelineConfig",
+           "convert_rvm", "rvm_key_for"]

@@ -1,17 +1,36 @@
-"""Robust Video Matting — recurrent ConvGRU matting network.
+"""Robust Video Matting — the published RVM network, TPU-native.
 
-Capability target: `templates/robust_video_matting.json` (SURVEY.md §2.3):
-video file in, matted video out (output_type ∈ green-screen | alpha-mask |
-foreground-mask). RVM's defining property is *recurrence*: per-scale
-ConvGRU states carry temporal context frame to frame, so the model streams
-— which on TPU means `lax.scan` over the frame axis with the GRU states as
-carry (no frame-axis SP here by design; the reference model is inherently
-sequential over frames, SURVEY.md §5 long-context notes).
+Capability target: `templates/robust_video_matting.json`, which pins
+github.com/PeterL1n/RobustVideoMatting (the `rvm_mobilenetv3` variant the
+reference's cog container serves). This module implements that published
+topology 1:1 so the published checkpoint converts onto this param tree
+(`models/rvm/convert.py`):
 
-Topology (faithful to the RVM design, sized for the template's task):
-strided-conv encoder pyramid (1/2..1/16) → bottleneck → decoder that
-upsamples with skip connections and a ConvGRU at each scale → output head
-producing alpha [0,1] + foreground residual.
+  backbone     MobileNetV3-Large encoder (torchvision layout: stem conv,
+               15 inverted-residual blocks, final 1×1 conv), last stage
+               dilated so f4 sits at 1/16 — taps f1@1/2(16ch),
+               f2@1/4(24ch), f3@1/8(40ch), f4@1/16(960ch)
+  aspp         LR-ASPP head: 1×1+BN+ReLU gated by a global-pool sigmoid
+               branch → 128ch
+  decoder      recurrent decoder: BottleneckBlock(ConvGRU over half the
+               channels) at 1/16, three UpsamplingBlocks (bilinear ×2 +
+               skip + downsampled-src concat + ConvGRU on half channels),
+               OutputBlock at full res
+  project_mat  1×1 conv → [fgr residual(3) | pha(1)]
+  project_seg  1×1 conv → segmentation logits (checkpoint completeness)
+  refiner      DeepGuidedFilter head used when inference runs the
+               downsample-then-refine path (the published auto
+               downsample_ratio = min(512/max(H,W), 1))
+
+RVM's defining property is *recurrence*: the four ConvGRU states carry
+temporal context frame to frame, so the model streams — on TPU that is
+`lax.scan` over the frame axis with the GRU states as carry (no frame-axis
+SP by design; the model is inherently sequential over frames, SURVEY.md §5).
+
+BatchNorm runs in inference form (`BNInf`): the published running stats are
+parameters, normalization is a fused scale/shift — the TPU-correct shape
+for a frozen-stats conv net (no batch-stat reductions in the scan body).
+Conv compute is bfloat16; norms, gates and the matting head are float32.
 """
 from __future__ import annotations
 
@@ -21,13 +40,52 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from arbius_tpu.models.common import GroupNorm32
+# torchvision mobilenet_v3_large inverted-residual plan, dilated last stage —
+# exactly the row list RVM's MobileNetV3LargeEncoder builds:
+# (in_ch, kernel, expanded_ch, out_ch, use_se, activation, stride, dilation)
+MOBILENETV3_LARGE_ROWS: tuple[tuple, ...] = (
+    (16, 3, 16, 16, False, "relu", 1, 1),
+    (16, 3, 64, 24, False, "relu", 2, 1),
+    (24, 3, 72, 24, False, "relu", 1, 1),
+    (24, 5, 72, 40, True, "relu", 2, 1),
+    (40, 5, 120, 40, True, "relu", 1, 1),
+    (40, 5, 120, 40, True, "relu", 1, 1),
+    (40, 3, 240, 80, False, "hardswish", 2, 1),
+    (80, 3, 200, 80, False, "hardswish", 1, 1),
+    (80, 3, 184, 80, False, "hardswish", 1, 1),
+    (80, 3, 184, 80, False, "hardswish", 1, 1),
+    (80, 3, 480, 112, True, "hardswish", 1, 1),
+    (112, 3, 672, 112, True, "hardswish", 1, 1),
+    (112, 5, 672, 160, True, "hardswish", 2, 2),
+    (160, 5, 960, 160, True, "hardswish", 1, 2),
+    (160, 5, 960, 160, True, "hardswish", 1, 2),
+)
+
+# ImageNet normalization the published backbone was trained with.
+_IMAGENET_MEAN = (0.485, 0.456, 0.406)
+_IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    """torchvision's channel-rounding rule (SE squeeze widths)."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
 
 
 @dataclass(frozen=True)
 class RVMConfig:
-    enc_channels: tuple[int, ...] = (16, 32, 64, 128)   # scales 1/2..1/16
-    dec_channels: tuple[int, ...] = (80, 40, 32, 16)    # coarse→fine
+    """Published rvm_mobilenetv3 by default; tiny() shrinks every stage but
+    keeps the exact module structure so the converter's key schema is
+    identical."""
+    ir_rows: tuple[tuple, ...] = MOBILENETV3_LARGE_ROWS
+    stem_ch: int = 16
+    last_ch: int = 960           # final 1×1 conv of the backbone
+    taps: tuple[int, int, int] = (1, 3, 6)  # feature idx for f1, f2, f3
+    aspp_ch: int = 128           # LR-ASPP out = bottleneck channels
+    dec_ch: tuple[int, int, int] = (80, 40, 32)  # UpsamplingBlock outs
+    out_ch: int = 16             # OutputBlock hidden = refiner hid channels
     dtype: str = "bfloat16"
 
     @property
@@ -36,98 +94,360 @@ class RVMConfig:
 
     @classmethod
     def tiny(cls) -> "RVMConfig":
-        return cls(enc_channels=(4, 8, 8, 8), dec_channels=(8, 8, 4, 4))
+        return cls(
+            ir_rows=(
+                (8, 3, 8, 8, False, "relu", 1, 1),
+                (8, 3, 16, 12, False, "relu", 2, 1),
+                (12, 5, 36, 12, True, "relu", 2, 1),
+                (12, 3, 24, 16, False, "hardswish", 2, 1),
+            ),
+            stem_ch=8, last_ch=24, taps=(1, 2, 3),
+            aspp_ch=16, dec_ch=(16, 8, 8), out_ch=8)
 
 
-class ConvGRUCell(nn.Module):
-    """Convolutional GRU over NHWC feature maps (the RVM recurrent unit)."""
+class BNInf(nn.Module):
+    """Inference-form BatchNorm2d: the published running stats are params.
+
+    Torch key mapping: scale↔weight, bias↔bias, mean↔running_mean,
+    var↔running_var (`num_batches_tracked` has no analogue). eps matches
+    the source module (1e-3 for torchvision MobileNetV3 BNs, 1e-5 for
+    RVM's own decoder/aspp/refiner BNs)."""
     channels: int
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (self.channels,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (self.channels,),
+                          jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (self.channels,),
+                          jnp.float32)
+        var = self.param("var", nn.initializers.ones, (self.channels,),
+                         jnp.float32)
+        orig = x.dtype
+        x = x.astype(jnp.float32)
+        x = (x - mean) * (scale * jax.lax.rsqrt(var + self.eps)) + bias
+        return x.astype(orig)
+
+
+def _act(name: str | None, x):
+    if name is None:
+        return x
+    if name == "relu":
+        return nn.relu(x)
+    if name == "hardswish":
+        # computed in f32: hard_swish has a subtraction of near-equal terms
+        return jax.nn.hard_swish(x.astype(jnp.float32)).astype(x.dtype)
+    raise ValueError(f"unknown activation {name!r}")
+
+
+class ConvBNAct(nn.Module):
+    """torchvision Conv2dNormActivation: conv(bias=False) + BN + act."""
+    channels: int
+    kernel: int = 3
+    stride: int = 1
+    dilation: int = 1
+    groups: int = 1
+    activation: str | None = "relu"
+    bn_eps: float = 1e-3
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, h, x):
-        hx = jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)],
-                             axis=-1)
-        zr = nn.Conv(2 * self.channels, (3, 3), padding=1, dtype=self.dtype,
-                     name="zr")(hx)
-        z, r = jnp.split(nn.sigmoid(zr.astype(jnp.float32)), 2, axis=-1)
-        cand = nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype,
-                       name="cand")(
-            jnp.concatenate([(r * h.astype(jnp.float32)).astype(self.dtype),
-                             x.astype(self.dtype)], axis=-1))
-        cand = jnp.tanh(cand.astype(jnp.float32))
-        return (1 - z) * h.astype(jnp.float32) + z * cand
+    def __call__(self, x):
+        pad = (self.kernel - 1) // 2 * self.dilation
+        x = nn.Conv(self.channels, (self.kernel, self.kernel),
+                    strides=(self.stride, self.stride), padding=pad,
+                    kernel_dilation=(self.dilation, self.dilation),
+                    feature_group_count=self.groups, use_bias=False,
+                    dtype=self.dtype, name="conv")(x)
+        x = BNInf(self.channels, eps=self.bn_eps, name="bn")(x)
+        return _act(self.activation, x)
 
 
-class EncoderBlock(nn.Module):
+class SqueezeExcite(nn.Module):
+    """torchvision SqueezeExcitation: pool → fc1 → ReLU → fc2 → hardsigmoid."""
+    channels: int
+    squeeze: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x.astype(jnp.float32), axis=(1, 2), keepdims=True)
+        s = nn.Conv(self.squeeze, (1, 1), dtype=jnp.float32, name="fc1")(s)
+        s = nn.relu(s)
+        s = nn.Conv(self.channels, (1, 1), dtype=jnp.float32, name="fc2")(s)
+        return (x.astype(jnp.float32) * jax.nn.hard_sigmoid(s)).astype(x.dtype)
+
+
+class InvertedResidual(nn.Module):
+    """One MobileNetV3 block; submodule presence mirrors torchvision, so
+    torch `block.{j}` indices are recoverable from the row alone."""
+    row: tuple  # (in, kernel, exp, out, se, act, stride, dilation)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch, kernel, exp, out, use_se, act, stride, dilation = self.row
+        # torchvision: dilation forces effective stride 1 (shape preserved)
+        eff_stride = 1 if dilation > 1 else stride
+        h = x
+        if exp != in_ch:
+            h = ConvBNAct(exp, 1, activation=act, dtype=self.dtype,
+                          name="expand")(h)
+        h = ConvBNAct(exp, kernel, stride=eff_stride, dilation=dilation,
+                      groups=exp, activation=act, dtype=self.dtype,
+                      name="depthwise")(h)
+        if use_se:
+            h = SqueezeExcite(exp, _make_divisible(exp // 4),
+                              dtype=self.dtype, name="se")(h)
+        h = ConvBNAct(out, 1, activation=None, dtype=self.dtype,
+                      name="project")(h)
+        if stride == 1 and in_ch == out:
+            h = h + x
+        return h
+
+
+class MobileNetV3Encoder(nn.Module):
+    """RVM's MobileNetV3LargeEncoder: normalize, stem, IR blocks, last 1×1;
+    returns the four pyramid taps (f1..f3 per config, f4 after last conv)."""
+    config: RVMConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dt = cfg.jdtype
+        x = (x.astype(jnp.float32) - jnp.asarray(_IMAGENET_MEAN)) \
+            / jnp.asarray(_IMAGENET_STD)
+        x = ConvBNAct(cfg.stem_ch, 3, stride=2, activation="hardswish",
+                      dtype=dt, name="stem")(x.astype(dt))
+        feats = {}
+        for i, row in enumerate(cfg.ir_rows):
+            x = InvertedResidual(row, dtype=dt, name=f"block_{i + 1}")(x)
+            feats[i + 1] = x
+        x = ConvBNAct(cfg.last_ch, 1, activation="hardswish", dtype=dt,
+                      name="lastconv")(x)
+        t1, t2, t3 = cfg.taps
+        return feats[t1], feats[t2], feats[t3], x
+
+
+class LRASPP(nn.Module):
+    """RVM's LR-ASPP: 1×1+BN+ReLU gated by global-pool → 1×1 → sigmoid."""
     channels: int
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(self.channels, (3, 3), strides=(2, 2), padding=1,
-                    dtype=self.dtype)(x)
-        x = GroupNorm32()(x)
-        x = nn.silu(x)
-        x = nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype)(x)
-        x = GroupNorm32()(x)
-        return nn.silu(x)
+        a = nn.Conv(self.channels, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="aspp1_conv")(x)
+        a = nn.relu(BNInf(self.channels, name="aspp1_bn")(a))
+        g = jnp.mean(x.astype(jnp.float32), axis=(1, 2), keepdims=True)
+        g = nn.Conv(self.channels, (1, 1), use_bias=False, dtype=jnp.float32,
+                    name="aspp2_conv")(g)
+        return (a.astype(jnp.float32) * nn.sigmoid(g)).astype(a.dtype)
 
 
-class RVMStep(nn.Module):
-    """One frame through encoder+recurrent decoder.
+class ConvGRU(nn.Module):
+    """RVM's ConvGRU: ih conv → sigmoid → (r,z); hh conv over [x, r·h] →
+    tanh candidate; h' = (1−z)·h + z·c. Gates in float32 (state is the
+    temporal memory; bf16 accumulation drifts over long streams)."""
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
 
-    __call__(frame[B,H,W,3], states) -> (alpha[B,H,W,1], fgr[B,H,W,3],
-    new_states); `states` is a tuple of per-scale GRU hidden maps.
-    """
+    @nn.compact
+    def __call__(self, x, h):
+        xh = jnp.concatenate([x.astype(self.dtype), h.astype(self.dtype)],
+                             axis=-1)
+        rz = nn.Conv(2 * self.channels, (3, 3), padding=1, dtype=self.dtype,
+                     name="ih")(xh)
+        r, z = jnp.split(nn.sigmoid(rz.astype(jnp.float32)), 2, axis=-1)
+        c = nn.Conv(self.channels, (3, 3), padding=1, dtype=self.dtype,
+                    name="hh")(
+            jnp.concatenate([x.astype(self.dtype),
+                             (r * h.astype(jnp.float32)).astype(self.dtype)],
+                            axis=-1))
+        c = jnp.tanh(c.astype(jnp.float32))
+        return (1.0 - z) * h.astype(jnp.float32) + z * c
+
+
+class BottleneckBlock(nn.Module):
+    """decode4: ConvGRU over the second half of the channels only."""
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, r):
+        a, b = jnp.split(x, 2, axis=-1)
+        b = ConvGRU(self.channels // 2, dtype=self.dtype, name="gru")(b, r)
+        return jnp.concatenate([a, b.astype(x.dtype)], axis=-1), b
+
+
+class UpsamplingBlock(nn.Module):
+    """decode3/2/1: bilinear ×2, concat [x | skip | downsampled src],
+    conv+BN+ReLU, ConvGRU over the second half of the channels."""
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, f, s, r):
+        b_, h, w, c = x.shape
+        x = jax.image.resize(x.astype(jnp.float32), (b_, 2 * h, 2 * w, c),
+                             method="bilinear").astype(self.dtype)
+        x = x[:, :s.shape[1], :s.shape[2]]  # crop to skip (odd sizes)
+        x = jnp.concatenate([x, f.astype(self.dtype), s.astype(self.dtype)],
+                            axis=-1)
+        x = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype, name="conv")(x)
+        x = nn.relu(BNInf(self.channels, name="bn")(x))
+        a, b = jnp.split(x, 2, axis=-1)
+        b = ConvGRU(self.channels // 2, dtype=self.dtype, name="gru")(b, r)
+        return jnp.concatenate([a, b.astype(x.dtype)], axis=-1), b
+
+
+class OutputBlock(nn.Module):
+    """decode0: bilinear ×2 to src res, concat src, two conv+BN+ReLU."""
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, s):
+        b_, h, w, c = x.shape
+        x = jax.image.resize(x.astype(jnp.float32), (b_, 2 * h, 2 * w, c),
+                             method="bilinear").astype(self.dtype)
+        x = x[:, :s.shape[1], :s.shape[2]]
+        x = jnp.concatenate([x, s.astype(self.dtype)], axis=-1)
+        x = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype, name="conv_a")(x)
+        x = nn.relu(BNInf(self.channels, name="bn_a")(x))
+        x = nn.Conv(self.channels, (3, 3), padding=1, use_bias=False,
+                    dtype=self.dtype, name="conv_b")(x)
+        return nn.relu(BNInf(self.channels, name="bn_b")(x))
+
+
+def _avgpool2(x):
+    """AvgPool2d(2,2) — pipeline guarantees even dims at every level."""
+    b, h, w, c = x.shape
+    return jnp.mean(x.reshape(b, h // 2, 2, w // 2, 2, c), axis=(2, 4))
+
+
+class RecurrentDecoder(nn.Module):
+    """RVM's RecurrentDecoder: src pyramid by avg-pool, four recurrent
+    stages coarse→fine; returns (hid at src res, new states r1..r4)."""
     config: RVMConfig
 
     @nn.compact
-    def __call__(self, frame, states):
+    def __call__(self, s0, f1, f2, f3, f4, rec):
         cfg = self.config
         dt = cfg.jdtype
-        x = frame.astype(dt)
-        feats = []
-        h = x
-        for i, ch in enumerate(cfg.enc_channels):
-            h = EncoderBlock(ch, dt, name=f"enc_{i}")(h)
-            feats.append(h)
+        r1, r2, r3, r4 = rec
+        s0 = s0.astype(jnp.float32)
+        s1 = _avgpool2(s0)
+        s2 = _avgpool2(s1)
+        s3 = _avgpool2(s2)
+        x4, r4 = BottleneckBlock(cfg.aspp_ch, dt, name="decode4")(f4, r4)
+        x3, r3 = UpsamplingBlock(cfg.dec_ch[0], dt, name="decode3")(
+            x4, f3, s3, r3)
+        x2, r2 = UpsamplingBlock(cfg.dec_ch[1], dt, name="decode2")(
+            x3, f2, s2, r2)
+        x1, r1 = UpsamplingBlock(cfg.dec_ch[2], dt, name="decode1")(
+            x2, f1, s1, r1)
+        x0 = OutputBlock(cfg.out_ch, dt, name="decode0")(x1, s0)
+        return x0, (r1, r2, r3, r4)
 
-        new_states = []
-        d = feats[-1]
-        for i, ch in enumerate(cfg.dec_channels):
-            scale_idx = len(cfg.enc_channels) - 1 - i
-            d = nn.Conv(ch, (3, 3), padding=1, dtype=dt,
-                        name=f"dec_conv_{i}")(d)
-            d = nn.silu(GroupNorm32(name=f"dec_norm_{i}")(d))
-            s = ConvGRUCell(ch, dt, name=f"gru_{i}")(states[i], d)
-            new_states.append(s)
-            d = s.astype(dt)
-            if scale_idx > 0:
-                b, hh, ww, c = d.shape
-                d = jax.image.resize(d, (b, hh * 2, ww * 2, c),
-                                     method="nearest")
-                skip = feats[scale_idx - 1]
-                d = jnp.concatenate([d, skip], axis=-1)
-        # final upsample to input resolution (encoder starts at 1/2)
-        b, hh, ww, c = d.shape
-        d = jax.image.resize(d, (b, hh * 2, ww * 2, c), method="nearest")
-        d = jnp.concatenate([d, x], axis=-1)
-        d = nn.Conv(cfg.dec_channels[-1], (3, 3), padding=1, dtype=dt,
-                    name="out_conv")(d)
-        d = nn.silu(GroupNorm32(name="out_norm")(d))
-        out = nn.Conv(4, (3, 3), padding=1, dtype=jnp.float32,
-                      name="head")(d.astype(jnp.float32))
-        alpha = nn.sigmoid(out[..., :1])
-        fgr = jnp.clip(frame.astype(jnp.float32) + out[..., 1:], 0.0, 1.0)
-        return alpha, fgr, tuple(new_states)
 
-    def init_states(self, batch: int, height: int, width: int):
-        """Zero GRU states for a (batch, H, W) stream."""
+class Projection(nn.Module):
+    """1×1 conv head (project_mat / project_seg)."""
+    channels: int
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(self.channels, (1, 1), dtype=jnp.float32,
+                       name="conv")(x.astype(jnp.float32))
+
+
+class DeepGuidedFilterRefiner(nn.Module):
+    """RVM's deep guided filter: box-filter statistics of the base
+    (downsampled) prediction against the base source, a learned 1×1 head
+    producing the affine A, bilinear-upsampled A·x+b on the fine source."""
+    hid_channels: int = 16
+
+    @nn.compact
+    def __call__(self, fine_src, base_src, base_fgr, base_pha, base_hid):
+        f32 = jnp.float32
+        fine_x = jnp.concatenate(
+            [fine_src, jnp.mean(fine_src, axis=-1, keepdims=True)],
+            axis=-1).astype(f32)
+        base_x = jnp.concatenate(
+            [base_src, jnp.mean(base_src, axis=-1, keepdims=True)],
+            axis=-1).astype(f32)
+        base_y = jnp.concatenate([base_fgr, base_pha], axis=-1).astype(f32)
+
+        box = nn.Conv(4, (3, 3), padding=1, feature_group_count=4,
+                      use_bias=False, dtype=f32, name="box_filter")
+        mean_x = box(base_x)
+        mean_y = box(base_y)
+        cov_xy = box(base_x * base_y) - mean_x * mean_y
+        var_x = box(base_x * base_x) - mean_x * mean_x
+
+        h = jnp.concatenate([cov_xy, var_x, base_hid.astype(f32)], axis=-1)
+        h = nn.Conv(self.hid_channels, (1, 1), use_bias=False, dtype=f32,
+                    name="conv_a")(h)
+        h = nn.relu(BNInf(self.hid_channels, name="bn_a")(h))
+        h = nn.Conv(self.hid_channels, (1, 1), use_bias=False, dtype=f32,
+                    name="conv_b")(h)
+        h = nn.relu(BNInf(self.hid_channels, name="bn_b")(h))
+        A = nn.Conv(4, (1, 1), dtype=f32, name="conv_c")(h)
+        b = mean_y - A * mean_x
+
+        bb, hh, ww, _ = fine_src.shape
+        A = jax.image.resize(A, (bb, hh, ww, 4), method="bilinear")
+        b = jax.image.resize(b, (bb, hh, ww, 4), method="bilinear")
+        out = A * fine_x + b
+        return out[..., :3], out[..., 3:]
+
+
+class MattingStep(nn.Module):
+    """One frame through the full MattingNetwork.
+
+    __call__(src[B,H,W,3] in [0,1], rec, base_hw) →
+    (fgr[B,H,W,3], pha[B,H,W,1], new_rec). `base_hw` is the static
+    downsampled working size; None runs the direct full-res path (no
+    refiner), matching the published downsample_ratio semantics. The
+    segmentation head is computed (and discarded by XLA when unused) so
+    its published weights live in the param tree."""
+    config: RVMConfig
+
+    @nn.compact
+    def __call__(self, src, rec, base_hw: tuple[int, int] | None = None):
         cfg = self.config
-        states = []
-        for i, ch in enumerate(cfg.dec_channels):
-            scale = 2 ** (len(cfg.enc_channels) - i)
-            states.append(jnp.zeros((batch, height // scale, width // scale,
-                                     ch), jnp.float32))
-        return tuple(states)
+        if base_hw is not None:
+            b, _, _, c = src.shape
+            src_sm = jax.image.resize(
+                src.astype(jnp.float32), (b, base_hw[0], base_hw[1], c),
+                method="bilinear")
+        else:
+            src_sm = src
+        f1, f2, f3, f4 = MobileNetV3Encoder(cfg, name="backbone")(src_sm)
+        f4 = LRASPP(cfg.aspp_ch, cfg.jdtype, name="aspp")(f4)
+        hid, new_rec = RecurrentDecoder(cfg, name="decoder")(
+            src_sm, f1, f2, f3, f4, rec)
+        out = Projection(4, name="project_mat")(hid)
+        _seg = Projection(1, name="project_seg")(hid)  # checkpoint parity
+        fgr_res, pha = out[..., :3], out[..., 3:]
+        if base_hw is not None:
+            fgr_res, pha = DeepGuidedFilterRefiner(
+                cfg.out_ch, name="refiner")(src, src_sm, fgr_res, pha, hid)
+        fgr = jnp.clip(fgr_res + src.astype(jnp.float32), 0.0, 1.0)
+        pha = jnp.clip(pha, 0.0, 1.0)
+        return fgr, pha, new_rec
+
+    def init_rec(self, batch: int, height: int, width: int):
+        """Zero GRU states for a working (base) resolution of H×W.
+        Scales: r1@1/2, r2@1/4, r3@1/8, r4@1/16; channels are half of
+        each stage's output (the GRU runs on the split half)."""
+        cfg = self.config
+        chans = (cfg.dec_ch[2] // 2, cfg.dec_ch[1] // 2, cfg.dec_ch[0] // 2,
+                 cfg.aspp_ch // 2)
+        return tuple(
+            jnp.zeros((batch, height >> s, width >> s, c), jnp.float32)
+            for s, c in zip((1, 2, 3, 4), chans))

@@ -1,7 +1,13 @@
 """RVM matting pipeline: streamed video → matted video.
 
-One jitted program scans all frames with the ConvGRU states as carry
-(`lax.scan` — the TPU form of the reference's frame-streaming container).
+One jitted program scans all frames with the four ConvGRU states as carry
+(`lax.scan` — the TPU form of the published model's frame-streaming
+inference loop). The published auto-downsample rule is applied statically
+per bucket: working resolution = min(512/max(H,W), 1) of the source
+(snapped to the encoder granule), with the DeepGuidedFilter refiner
+recovering full resolution — the same downsample-then-refine path the
+reference's cog container runs on large frames.
+
 Output composition follows the template's output_type enum
 (`templates/robust_video_matting.json`):
 
@@ -21,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from arbius_tpu.models.rvm.model import RVMConfig, RVMStep
+from arbius_tpu.models.rvm.model import MattingStep, RVMConfig
 
 OUTPUT_TYPES = ("green-screen", "alpha-mask", "foreground-mask")
 
@@ -29,6 +35,8 @@ OUTPUT_TYPES = ("green-screen", "alpha-mask", "foreground-mask")
 @dataclass(frozen=True)
 class RVMPipelineConfig:
     model: RVMConfig = RVMConfig()
+    # published inference.py auto_downsample_ratio: min(512 / max(h, w), 1)
+    auto_downsample_px: int = 512
 
     @classmethod
     def tiny(cls) -> "RVMPipelineConfig":
@@ -40,31 +48,48 @@ class RVMPipeline:
 
     def __init__(self, config: RVMPipelineConfig | None = None):
         self.config = config or RVMPipelineConfig()
-        self.step = RVMStep(self.config.model)
+        self.step = MattingStep(self.config.model)
         self._buckets: dict[tuple, object] = {}
+
+    def base_hw(self, height: int, width: int) -> tuple[int, int] | None:
+        """Static working resolution per the published auto rule; None =
+        run direct (no refiner). Snapped to GRANULE so every pyramid level
+        has even dims (the published crop semantics then cost nothing)."""
+        ratio = min(self.config.auto_downsample_px / max(height, width), 1.0)
+        if ratio >= 1.0:
+            return None
+        g = self.GRANULE
+        snap = lambda v: max(g, int(round(v * ratio / g)) * g)  # noqa: E731
+        return snap(height), snap(width)
 
     def init_params(self, seed: int = 0, height: int = 64,
                     width: int = 64) -> dict:
         frame = jnp.zeros((1, height, width, 3))
-        states = self.step.init_states(1, height, width)
-        return self.step.init(jax.random.PRNGKey(seed), frame,
-                              states)["params"]
+        # init through the downsample+refine path so the refiner's
+        # published weights are materialized in the tree; base snapped to
+        # the granule like base_hw does
+        g = self.GRANULE
+        base = (max(g, height // 2 // g * g), max(g, width // 2 // g * g))
+        rec = self.step.init_rec(1, *base)
+        return self.step.init(jax.random.PRNGKey(seed), frame, rec,
+                              base)["params"]
 
     def compiled_bucket(self, frames: int, height: int, width: int):
         key = (frames, height, width)
         cached = self._buckets.get(key)
         if cached is not None:
             return cached
+        base = self.base_hw(height, width)
 
         def run(params, video):  # video: f32 [T, H, W, 3] in [0, 1]
-            states = self.step.init_states(1, height, width)
+            rec = self.step.init_rec(1, *(base or (height, width)))
 
-            def body(states, frame):
-                alpha, fgr, states = self.step.apply(
-                    {"params": params}, frame[None], states)
-                return states, (alpha[0], fgr[0])
+            def body(rec, frame):
+                fgr, pha, rec = self.step.apply(
+                    {"params": params}, frame[None], rec, base)
+                return rec, (pha[0], fgr[0])
 
-            _, (alphas, fgrs) = jax.lax.scan(body, states, video)
+            _, (alphas, fgrs) = jax.lax.scan(body, rec, video)
             return alphas, fgrs
 
         fn = jax.jit(run)

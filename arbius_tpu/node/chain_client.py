@@ -94,6 +94,14 @@ class LocalChain:
         return _h(self._tx(lambda: self.engine.submit_task(
             self.address, version, owner, _b(model), fee, input_)))
 
+    def ensure_fee_allowance(self, fee: int) -> None:
+        """Approve the engine to pull `fee` before submitTask — EngineV1
+        collects via transferFrom (the dapp's approve-then-submit)."""
+        if fee and self.engine.token.allowances.get(
+                (self.address, self.engine.ADDRESS), 0) < fee:
+            self._tx(lambda: self.engine.token.approve(
+                self.address, self.engine.ADDRESS, fee))
+
     def signal_commitment(self, commitment: bytes) -> None:
         self._tx(lambda: self.engine.signal_commitment(
             self.address, commitment))

@@ -220,25 +220,25 @@ class NodeDB:
             self._conn.commit()
             return cur.rowcount
 
+    # the explorer/task/history pages all read the same task+solution view
+    _TASK_VIEW = (
+        "SELECT t.id, t.modelid, t.fee, t.address, t.blocktime, "
+        "s.validator, s.cid, s.claimed, "
+        "(SELECT 1 FROM invalid_tasks i WHERE i.taskid = t.id) inv "
+        "FROM tasks t LEFT JOIN solutions s ON s.taskid = t.id ")
+
     def recent_tasks(self, limit: int = 50) -> list[sqlite3.Row]:
         """Task + solution join for the explorer, newest first."""
         with self._lock:
             return self._conn.execute(
-                "SELECT t.id, t.modelid, t.fee, t.address, t.blocktime, "
-                "s.validator, s.cid, s.claimed, "
-                "(SELECT 1 FROM invalid_tasks i WHERE i.taskid = t.id) inv "
-                "FROM tasks t LEFT JOIN solutions s ON s.taskid = t.id "
-                "ORDER BY t.rowid DESC LIMIT ?", (limit,)).fetchall()
+                self._TASK_VIEW + "ORDER BY t.rowid DESC LIMIT ?",
+                (limit,)).fetchall()
 
     def task_view(self, taskid: str) -> sqlite3.Row | None:
         """One task + solution join row (the task page's data source)."""
         with self._lock:
             return self._conn.execute(
-                "SELECT t.id, t.modelid, t.fee, t.address, t.blocktime, "
-                "s.validator, s.cid, s.claimed, "
-                "(SELECT 1 FROM invalid_tasks i WHERE i.taskid = t.id) inv "
-                "FROM tasks t LEFT JOIN solutions s ON s.taskid = t.id "
-                "WHERE t.id = ?", (taskid,)).fetchone()
+                self._TASK_VIEW + "WHERE t.id = ?", (taskid,)).fetchone()
 
     def tasks_by_address(self, address: str,
                          limit: int = 100) -> list[sqlite3.Row]:
@@ -247,10 +247,7 @@ class NodeDB:
         addr = address.lower()
         with self._lock:
             return self._conn.execute(
-                "SELECT t.id, t.modelid, t.fee, t.address, t.blocktime, "
-                "s.validator, s.cid, s.claimed, "
-                "(SELECT 1 FROM invalid_tasks i WHERE i.taskid = t.id) inv "
-                "FROM tasks t LEFT JOIN solutions s ON s.taskid = t.id "
+                self._TASK_VIEW +
                 "WHERE lower(t.address) = ? OR lower(s.validator) = ? "
                 "ORDER BY t.rowid DESC LIMIT ?",
                 (addr, addr, limit)).fetchall()

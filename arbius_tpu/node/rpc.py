@@ -179,6 +179,7 @@ class ControlRPC:
         hydrate_input(dict(raw), m.template)  # reject before paying the fee
         fee = int(body.get("fee") or 0)  # str or int; wad > 2^53 arrives str
         input_bytes = json.dumps(raw, separators=(",", ":")).encode()
+        self.node.chain.ensure_fee_allowance(fee)  # engine pulls the fee
         taskid = self.node.chain.submit_task(0, self.node.chain.address,
                                              model_id, fee, input_bytes)
         return {"taskid": taskid or None, "submitted": True}

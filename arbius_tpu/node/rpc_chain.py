@@ -239,6 +239,18 @@ class RpcChain:
         except RpcError as e:
             raise _engine_error(e) from None
 
+    def ensure_fee_allowance(self, fee: int) -> None:
+        """Approve the engine to pull `fee` before submitTask — same
+        approve-then-act pattern as staking (blockchain.ts:60-67)."""
+        if fee and self.token_allowance(self.client.engine_address) < fee:
+            try:
+                self.client.send_to(
+                    self.token_address, "approve(address,uint256)",
+                    ["address", "uint256"],
+                    [self.client.engine_address, fee])
+            except RpcError as e:
+                raise _engine_error(e) from None
+
     def submit_task(self, version: int, owner: str, model: str, fee: int,
                     input_: bytes) -> str:
         self._send("submitTask", [version, owner, model, fee, input_])

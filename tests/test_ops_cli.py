@@ -123,7 +123,7 @@ def test_governance_lifecycle(world, capsys):
     prop = run_cli(capsys, [
         "governance", "propose", *base,
         "--fn", "setSolutionMineableRate(bytes32,uint256)",
-        "--types", "bytes32,uint256", "--args", mid, str(rate),
+        "--args", mid, str(rate),
         "--description", "make kandinsky2 mineable"])
     pid = prop["proposal_id"]
 
@@ -164,7 +164,6 @@ def test_unauthorized_governance_call_refused(world, capsys):
         main(["governance", "propose", "--deployment", dep,
               "--key", "0x" + operator.private_key.hex(),
               "--fn", "validatorDeposit(address,uint256)",
-              "--types", "address,uint256",
               "--args", operator.address, "1",
               "--description", "sneaky"])
 
@@ -205,3 +204,52 @@ def test_task_status_unknown_task_errors(world, capsys):
 
 def dep_url(dep_path: str) -> str:
     return json.loads(open(dep_path).read())["rpc_url"]
+
+
+def test_same_description_distinct_actions_distinct_pids(world, capsys):
+    """OZ binds the proposal id to the calldata; the devnet surface must
+    too — same description, different action, different id."""
+    eng, dev, operator, miner, dep = world
+    base = ["--deployment", dep, "--key", "0x" + operator.private_key.hex()]
+    run_cli(capsys, ["governance", "delegate", *base])
+    run_cli(capsys, ["timetravel", "--deployment", dep, "--blocks", "1"])
+    p1 = run_cli(capsys, ["governance", "propose", *base,
+                          "--fn", "setPaused(bool)", "--args", "true",
+                          "--description", "maintenance"])
+    p2 = run_cli(capsys, ["governance", "propose", *base,
+                          "--fn", "setPaused(bool)", "--args", "false",
+                          "--description", "maintenance"])
+    assert p1["proposal_id"] and p2["proposal_id"]
+    assert p1["proposal_id"] != p2["proposal_id"]
+
+
+def test_failed_execution_leaves_proposal_queued(world, capsys):
+    """No EVM rollback in-process: a reverting action must leave the
+    proposal re-executable (QUEUED), not EXECUTED-with-no-effect."""
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    eng, dev, operator, miner, dep = world
+    base = ["--deployment", dep, "--key", "0x" + operator.private_key.hex()]
+    run_cli(capsys, ["governance", "delegate", *base])
+    run_cli(capsys, ["timetravel", "--deployment", dep, "--blocks", "1"])
+    prop = run_cli(capsys, [
+        "governance", "propose", *base,
+        "--fn", "setSolutionMineableRate(bytes32,uint256)",
+        "--args", "0x" + "ee" * 32, "7",  # model never registered
+        "--description", "rate on a ghost model"])
+    pid = prop["proposal_id"]
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_DELAY + 1)])
+    run_cli(capsys, ["governance", "vote", *base, "--pid", pid,
+                     "--support", "1"])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_PERIOD + 1)])
+    run_cli(capsys, ["governance", "queue", *base, "--pid", pid])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--seconds", str(TIMELOCK_MIN_DELAY + 1), "--blocks", "1"])
+    with pytest.raises(RpcError, match="model does not exist"):
+        main(["governance", "execute", *base, "--pid", pid])
+    capsys.readouterr()
+    view = run_cli(capsys, ["governance", "proposal", "--deployment", dep,
+                            "--pid", pid])
+    assert view["state"] == "QUEUED"  # still re-executable

