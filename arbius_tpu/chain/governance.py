@@ -58,6 +58,7 @@ class Proposal:
     abstain_votes: int = 0
     eta: int | None = None
     executed: bool = False
+    executed_actions: int = 0   # progress cursor for failure-safe retry
     voted: set = field(default_factory=set)
 
 
@@ -175,8 +176,12 @@ class Governor:
         # run the actions BEFORE marking executed: there is no EVM-style
         # tx rollback here, so a reverting action must leave the proposal
         # QUEUED (re-executable after the cause is fixed), not permanently
-        # EXECUTED-with-no-effect
-        for action in p.actions:
-            action()
+        # EXECUTED-with-no-effect. The progress cursor makes a retry
+        # resume AFTER the actions that already applied — re-running them
+        # would double-apply (e.g. a treasury transfer before the failing
+        # action).
+        while p.executed_actions < len(p.actions):
+            p.actions[p.executed_actions]()
+            p.executed_actions += 1
         p.executed = True
         self.engine._emit("ProposalExecuted", id=pid)

@@ -47,6 +47,9 @@ def _wad(amount: str) -> int:
         wad = Decimal(amount) * 10**18
     except InvalidOperation:
         raise SystemExit(f"bad AIUS amount {amount!r}")
+    if not wad.is_finite() or wad < 0:
+        raise SystemExit(f"AIUS amount must be finite and >= 0, "
+                         f"got {amount!r}")
     if wad != int(wad):
         raise SystemExit(f"{amount!r} has more than 18 decimal places")
     return int(wad)

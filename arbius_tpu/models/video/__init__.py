@@ -1,14 +1,20 @@
-"""Text-to-video model family: UNet3D (zeroscope/damo template classes)
-with built-in frame-axis sequence parallelism."""
+"""Text-to-video model family: the published UNet3DConditionModel
+topology (zeroscope/damo template classes) with built-in frame-axis
+sequence parallelism."""
+from arbius_tpu.models.video.convert import (
+    convert_unet3d,
+    unet3d_key_for,
+)
 from arbius_tpu.models.video.pipeline import Text2VideoConfig, Text2VideoPipeline
 from arbius_tpu.models.video.unet3d import (
-    TemporalAttention,
-    TemporalConv,
+    TemporalConvLayer,
+    TemporalTransformer,
     UNet3DCondition,
     UNet3DConfig,
 )
 
 __all__ = [
-    "TemporalAttention", "TemporalConv", "Text2VideoConfig",
+    "TemporalConvLayer", "TemporalTransformer", "Text2VideoConfig",
     "Text2VideoPipeline", "UNet3DCondition", "UNet3DConfig",
+    "convert_unet3d", "unet3d_key_for",
 ]

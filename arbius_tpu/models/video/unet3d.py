@@ -2,20 +2,29 @@
 
 Capability target: `templates/zeroscopev2xl.json` (≤96 frames, 1024×576)
 and `templates/damo.json` (ModelScope 1.7B, 16 frames) — SURVEY.md §2.3.
+Both published checkpoints are the diffusers `UNet3DConditionModel`
+layout (zeroscope v2 is a fine-tune of the ModelScope topology), and this
+module implements that exact structure so the published weights convert
+1:1 (`models/video/convert.py`):
 
-Architecture: the standard factorized inflation of the 2D UNet — every
-level interleaves (a) spatial resnet + spatial/text transformer applied
-per-frame, with (b) temporal convolution and (c) temporal attention
-applied per-pixel across frames. Temporal residual branches are
-zero-initialized, so at init the model is exactly the 2D UNet replicated
-over frames (the standard inflation trick, and a free correctness check).
+  conv_in → transformer_in (temporal, 8 heads) → CrossAttnDownBlock3D ×3
+  + DownBlock3D → UNetMidBlock3DCrossAttn → mirrored up blocks →
+  conv_norm_out/conv_out. Every block layer runs resnet → TemporalConvLayer
+  (4 GN+SiLU+frame-conv stages, last zero-init) → Transformer2DModel
+  (spatial, per-frame) → TransformerTemporalModel (per-pixel over frames,
+  double self-attention + GEGLU FF).
 
 Sequence parallelism is built in, not bolted on (SURVEY.md §2.6 plan):
 with `sp_axis` set, the module runs under shard_map with the frame axis
 sharded — temporal convs fetch a 1-frame halo from ring neighbours
-(`halo_exchange`), temporal attention runs as ring attention
-(`ops.ring_attention`), everything else is frame-local. Comms per step:
-O(halo) + (sp-1) K/V hops, all ICI.
+(`halo_exchange`) per conv stage, temporal attention runs as ring
+attention (`ops.ring_attention`), everything else is frame-local. Comms
+per step: O(halo) + (sp-1) K/V hops, all ICI.
+
+At init the model is exactly the 2D UNet replicated over frames: the
+published TemporalConvLayer zero-inits its last conv, and the temporal
+transformers here zero-init proj_out (free correctness check; converted
+checkpoints overwrite it either way).
 
 Shapes: __call__(x[B, T, H, W, C], t[B], context[B, L, D]) — T is the
 per-shard frame count under shard_map, the full count otherwise.
@@ -28,6 +37,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from arbius_tpu.models.common import (
+    GEGLU,
     Downsample,
     GroupNorm32,
     ResnetBlock,
@@ -47,10 +57,10 @@ class UNet3DConfig:
     block_channels: tuple[int, ...] = (320, 640, 1280, 1280)
     layers_per_block: int = 2
     attention_levels: tuple[bool, ...] = (True, True, True, False)
-    num_heads: int = 8
+    head_dim: int = 64            # spatial+temporal heads = ch // head_dim
+    tin_heads: int = 8            # transformer_in head count (published: 8)
     context_dim: int = 1024
     transformer_depth: int = 1
-    temporal_kernel: int = 3
     sp_axis: str | None = None    # mesh axis frames are sharded over
     dtype: str = "bfloat16"
 
@@ -61,76 +71,126 @@ class UNet3DConfig:
     @classmethod
     def tiny(cls, sp_axis: str | None = None) -> "UNet3DConfig":
         return cls(block_channels=(8, 8, 8, 8), layers_per_block=1,
-                   num_heads=2, context_dim=16, sp_axis=sp_axis)
+                   head_dim=4, tin_heads=2, context_dim=16, sp_axis=sp_axis)
 
 
-class TemporalConv(nn.Module):
-    """Residual temporal conv; zero-init out ⇒ identity at init.
-
-    Under sp, the kernel's (k-1)/2-frame halo comes from ring neighbours;
-    edge shards see zeros — identical to the unsharded 'SAME' padding.
-    """
+class TemporalConvLayer(nn.Module):
+    """Published diffusers TemporalConvLayer: four GN+SiLU+(3,1,1)-conv
+    stages with a zero-init final conv, residual. A (3,1,1) Conv3d is a
+    1-frame-halo conv along the frame axis, so under sp each stage halo-
+    exchanges one frame from its ring neighbours; edge shards see zeros —
+    identical to the unsharded 'SAME' padding."""
     channels: int
-    kernel: int = 3
     sp_axis: str | None = None
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):  # [B, T, H, W, C]
-        h = GroupNorm32(name="norm")(x)
-        h = nn.silu(h).astype(self.dtype)
-        halo = (self.kernel - 1) // 2
-        # operate with T adjacent to channels: [B, H, W, T, C]
-        h = h.transpose(0, 2, 3, 1, 4)
-        if self.sp_axis is not None:
-            h = halo_exchange(h, self.sp_axis, axis=3, halo=halo)
-            pad = "VALID"
-        else:
-            pad = [(halo, halo)]
-        h = nn.Conv(self.channels, (self.kernel,), padding=pad,
-                    dtype=self.dtype, name="conv")(h)
-        h = nn.Conv(self.channels, (1,), dtype=self.dtype,
-                    kernel_init=nn.initializers.zeros,
-                    name="proj_out")(h)
-        return x + h.transpose(0, 3, 1, 2, 4)
+        h = x
+        for name in ("conv1", "conv2", "conv3", "conv4"):
+            h = GroupNorm32(name=f"{name}_norm")(h)
+            h = nn.silu(h).astype(self.dtype)
+            # frame-axis conv: operate with T adjacent to channels
+            h = h.transpose(0, 2, 3, 1, 4)          # [B, H, W, T, C]
+            if self.sp_axis is not None:
+                h = halo_exchange(h, self.sp_axis, axis=3, halo=1)
+                pad = "VALID"
+            else:
+                pad = [(1, 1)]
+            h = nn.Conv(self.channels, (3,), padding=pad, dtype=self.dtype,
+                        kernel_init=(nn.initializers.zeros
+                                     if name == "conv4"
+                                     else nn.initializers.lecun_normal()),
+                        name=name)(h)
+            h = h.transpose(0, 3, 1, 2, 4)
+        return x + h
 
 
-class TemporalAttention(nn.Module):
-    """Per-pixel attention across frames; zero-init out ⇒ identity at init.
+class TemporalSelfAttention(nn.Module):
+    """Self-attention over the frame axis ([N, T, C] tokens = frames).
 
-    With sp_axis: exact ring attention over the sharded frame axis.
-    """
-    channels: int
+    With sp_axis: exact ring attention over the sharded frame axis —
+    online-softmax passes of K/V around the ring (ops/ring.py)."""
     num_heads: int
+    head_dim: int
     sp_axis: str | None = None
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):  # [B, T, H, W, C]
-        b, t, hh, ww, c = x.shape
-        head_dim = c // self.num_heads
-        h = GroupNorm32(name="norm")(x).astype(self.dtype)
-        # tokens: frames; batch: every spatial site → [B*H*W, heads, T, D]
-        h = h.transpose(0, 2, 3, 1, 4).reshape(b * hh * ww, t, c)
-        qkv = nn.Dense(3 * c, use_bias=False, dtype=self.dtype,
-                       name="to_qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+    def __call__(self, x):
+        n, t, c = x.shape
+        inner = self.num_heads * self.head_dim
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(x)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(x)
 
         def heads(a):
-            return a.reshape(a.shape[0], t, self.num_heads,
-                             head_dim).transpose(0, 2, 1, 3)
+            return a.reshape(n, t, self.num_heads,
+                             self.head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
         if self.sp_axis is not None:
             out = ring_attention(q, k, v, axis_name=self.sp_axis)
         else:
             out = sp_attention_reference(q, k, v)
-        out = out.transpose(0, 2, 1, 3).reshape(b * hh * ww, t, c)
-        out = nn.Dense(c, dtype=self.dtype,
-                       kernel_init=nn.initializers.zeros,
-                       name="to_out")(out)
-        out = out.reshape(b, hh, ww, t, c).transpose(0, 3, 1, 2, 4)
-        return x + out
+        out = out.transpose(0, 2, 1, 3).reshape(n, t, inner)
+        return nn.Dense(c, dtype=self.dtype, name="to_out")(out)
+
+
+class TemporalTransformerBlock(nn.Module):
+    """Published BasicTransformerBlock under double_self_attention=True
+    (the TransformerTemporalModel configuration): LN→self-attn,
+    LN→second self-attn, LN→GEGLU FF, all residual."""
+    num_heads: int
+    head_dim: int
+    sp_axis: str | None = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        f32 = jnp.float32
+        x = x + TemporalSelfAttention(
+            self.num_heads, self.head_dim, self.sp_axis, self.dtype,
+            name="attn1")(nn.LayerNorm(dtype=f32, name="norm1")(x)
+                          .astype(self.dtype))
+        x = x + TemporalSelfAttention(
+            self.num_heads, self.head_dim, self.sp_axis, self.dtype,
+            name="attn2")(nn.LayerNorm(dtype=f32, name="norm2")(x)
+                          .astype(self.dtype))
+        h = nn.LayerNorm(dtype=f32, name="norm3")(x).astype(self.dtype)
+        h = GEGLU(x.shape[-1] * 4, self.dtype, name="ff")(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="ff_out")(h)
+        return x + h
+
+
+class TemporalTransformer(nn.Module):
+    """Published TransformerTemporalModel: GroupNorm, linear proj_in,
+    transformer blocks over the frame axis per spatial site, linear
+    proj_out, residual. `inner = heads·head_dim` may differ from the
+    channel count (transformer_in: 8×64=512 over 320 channels)."""
+    num_heads: int
+    head_dim: int
+    depth: int = 1
+    sp_axis: str | None = None
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, H, W, C]
+        b, t, hh, ww, c = x.shape
+        h = GroupNorm32(name="norm")(x).astype(self.dtype)
+        # tokens: frames; batch: every spatial site → [B*H*W, T, C]
+        h = h.transpose(0, 2, 3, 1, 4).reshape(b * hh * ww, t, c)
+        h = nn.Dense(self.num_heads * self.head_dim, dtype=self.dtype,
+                     name="proj_in")(h)
+        for i in range(self.depth):
+            h = TemporalTransformerBlock(
+                self.num_heads, self.head_dim, self.sp_axis, self.dtype,
+                name=f"block_{i}")(h)
+        # zero-init: temporal branch is identity at init (inflation check)
+        h = nn.Dense(c, dtype=self.dtype, kernel_init=nn.initializers.zeros,
+                     name="proj_out")(h)
+        h = h.reshape(b, hh, ww, t, c).transpose(0, 3, 1, 2, 4)
+        return x + h
 
 
 class UNet3DCondition(nn.Module):
@@ -162,22 +222,32 @@ class UNet3DCondition(nn.Module):
 
         def attn(ch, name):
             return lambda h2d: SpatialTransformer(
-                cfg.num_heads, ch // cfg.num_heads, cfg.transformer_depth,
+                ch // cfg.head_dim, cfg.head_dim, cfg.transformer_depth,
                 dt, name=name)(h2d, ctx_rep[:h2d.shape[0]])
+
+        def tconv(ch, name):
+            return TemporalConvLayer(ch, cfg.sp_axis, dt, name=name)
+
+        def tattn(ch, name):
+            return TemporalTransformer(ch // cfg.head_dim, cfg.head_dim,
+                                       cfg.transformer_depth, cfg.sp_axis,
+                                       dt, name=name)
 
         h = self._spatial(
             lambda z: nn.Conv(cfg.block_channels[0], (3, 3), padding=1,
                               dtype=dt, name="conv_in")(z), x)
+        # published: temporal transformer on the stem, fixed head count
+        h = TemporalTransformer(cfg.tin_heads, cfg.head_dim,
+                                cfg.transformer_depth, cfg.sp_axis, dt,
+                                name="transformer_in")(h)
         skips = [h]
         for level, ch in enumerate(cfg.block_channels):
             for j in range(cfg.layers_per_block):
                 h = self._spatial(res(ch, f"down_{level}_res_{j}"), h)
-                h = TemporalConv(ch, cfg.temporal_kernel, cfg.sp_axis, dt,
-                                 name=f"down_{level}_tconv_{j}")(h)
+                h = tconv(ch, f"down_{level}_tconv_{j}")(h)
                 if cfg.attention_levels[level]:
                     h = self._spatial(attn(ch, f"down_{level}_attn_{j}"), h)
-                    h = TemporalAttention(ch, cfg.num_heads, cfg.sp_axis, dt,
-                                          name=f"down_{level}_tattn_{j}")(h)
+                    h = tattn(ch, f"down_{level}_tattn_{j}")(h)
                 skips.append(h)
             if level < len(cfg.block_channels) - 1:
                 h = self._spatial(
@@ -185,26 +255,24 @@ class UNet3DCondition(nn.Module):
                         ch, dt, name=f"down_{level}_ds")(z), h)
                 skips.append(h)
 
+        # published mid block: res0 → tconv0 → attn → tattn → res1 → tconv1
         mid_ch = cfg.block_channels[-1]
         h = self._spatial(res(mid_ch, "mid_res_0"), h)
-        h = TemporalConv(mid_ch, cfg.temporal_kernel, cfg.sp_axis, dt,
-                         name="mid_tconv")(h)
+        h = tconv(mid_ch, "mid_tconv_0")(h)
         h = self._spatial(attn(mid_ch, "mid_attn"), h)
-        h = TemporalAttention(mid_ch, cfg.num_heads, cfg.sp_axis, dt,
-                              name="mid_tattn")(h)
+        h = tattn(mid_ch, "mid_tattn")(h)
         h = self._spatial(res(mid_ch, "mid_res_1"), h)
+        h = tconv(mid_ch, "mid_tconv_1")(h)
 
         for level in reversed(range(len(cfg.block_channels))):
             ch = cfg.block_channels[level]
             for j in range(cfg.layers_per_block + 1):
                 h = jnp.concatenate([h, skips.pop()], axis=-1)
                 h = self._spatial(res(ch, f"up_{level}_res_{j}"), h)
-                h = TemporalConv(ch, cfg.temporal_kernel, cfg.sp_axis, dt,
-                                 name=f"up_{level}_tconv_{j}")(h)
+                h = tconv(ch, f"up_{level}_tconv_{j}")(h)
                 if cfg.attention_levels[level]:
                     h = self._spatial(attn(ch, f"up_{level}_attn_{j}"), h)
-                    h = TemporalAttention(ch, cfg.num_heads, cfg.sp_axis, dt,
-                                          name=f"up_{level}_tattn_{j}")(h)
+                    h = tattn(ch, f"up_{level}_tattn_{j}")(h)
             if level > 0:
                 h = self._spatial(
                     lambda z, ch=ch, level=level: Upsample(

@@ -155,3 +155,59 @@ def test_description_cid_stored():
     from arbius_tpu.l0.cid import cid_onchain
     assert p.description_cid == cid_onchain(b"ipfs me")
     assert gov.proposals_created == [pid]
+
+
+def _count_world():
+    """Minimal world for multi-action execute-retry semantics."""
+    from arbius_tpu.chain import Engine, TokenLedger, WAD
+    from arbius_tpu.chain.governance import (
+        Governor,
+        TIMELOCK_MIN_DELAY,
+        VOTING_DELAY,
+        VOTING_PERIOD,
+    )
+
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=1000)
+    voter = "0x" + "aa" * 20
+    tok.mint(voter, 600_000 * WAD)
+    tok.delegate(voter, voter)
+    eng.mine_block()
+    gov = Governor(eng)
+    return eng, gov, voter, (VOTING_DELAY, VOTING_PERIOD, TIMELOCK_MIN_DELAY)
+
+
+def test_failed_action_retry_does_not_double_apply():
+    """A multi-action proposal whose second action reverts must stay
+    QUEUED, and a retry must resume AFTER the action that already ran
+    (no double-apply of action 1)."""
+    import pytest as _pytest
+
+    from arbius_tpu.chain.governance import GovernanceError, ProposalState
+
+    eng, gov, voter, (delay, period, tl) = _count_world()
+    ran = []
+    fail = [True]
+
+    def a1():
+        ran.append("a1")
+
+    def a2():
+        if fail[0]:
+            raise GovernanceError("boom")
+        ran.append("a2")
+
+    pid = gov.propose(voter, [a1, a2], "two actions")
+    eng.advance_time(1, blocks=delay + 1)
+    gov.cast_vote(voter, pid, 1)
+    eng.advance_time(1, blocks=period + 1)
+    gov.queue(pid)
+    eng.advance_time(tl + 1, blocks=1)
+    with _pytest.raises(GovernanceError, match="boom"):
+        gov.execute(pid)
+    assert gov.state(pid) == ProposalState.QUEUED   # re-executable
+    assert ran == ["a1"]
+    fail[0] = False
+    gov.execute(pid)
+    assert ran == ["a1", "a2"]                      # a1 NOT re-applied
+    assert gov.state(pid) == ProposalState.EXECUTED
