@@ -125,29 +125,87 @@ def dag_of_file(content: bytes) -> DagNode:
     return level[0]
 
 
-def dag_of_directory(entries: dict[str, bytes]) -> DagNode:
+HAMT_FANOUT = 256              # kubo DefaultShardWidth
+HAMT_HASH_MURMUR3 = 0x22       # multihash code for murmur3-x64-64
+
+
+def _hamt_shard(items: list[tuple[str, DagNode]], depth: int,
+                sink=None) -> DagNode:
+    """One HAMT shard node (UnixFS Type=5) over (name, child) entries.
+
+    go-unixfs layout: slot index at depth d = byte d of the murmur3-64
+    name hash; an occupied slot holds either the entry itself (link named
+    '%02X' + name) or a child shard ('%02X' alone) when names collide at
+    this depth. The Data field is the occupancy bitfield as a minimal
+    big-endian integer; hashType/fanout ride UnixFS fields 5/6."""
+    from arbius_tpu.l0.murmur3 import hamt_hash
+
+    if depth >= 8:
+        # 8 hash bytes consumed — 256^8 slots; unreachable without a
+        # deliberate collision attack on murmur3
+        raise ValueError("HAMT depth exhausted (hash collision)")
+    slots: dict[int, list[tuple[str, DagNode]]] = {}
+    for name, node in items:
+        slots.setdefault(hamt_hash(name)[depth], []).append((name, node))
+    links = b""
+    bitfield = 0
+    tsize_children = 0
+    for idx in sorted(slots):
+        bitfield |= 1 << idx
+        bucket = slots[idx]
+        if len(bucket) == 1:
+            name, node = bucket[0]
+            links += _pblink(node, f"{idx:02X}{name}")
+        else:
+            node = _hamt_shard(bucket, depth + 1, sink)
+            links += _pblink(node, f"{idx:02X}")
+        tsize_children += node.tsize
+    bf_bytes = bitfield.to_bytes((bitfield.bit_length() + 7) // 8, "big")
+    unixfs = b"\x08\x05"                      # Type = HAMTShard
+    unixfs += _lenprefixed(b"\x12", bf_bytes)  # Data = bitfield
+    unixfs += b"\x28" + encode_varint(HAMT_HASH_MURMUR3)  # hashType
+    unixfs += b"\x30" + encode_varint(HAMT_FANOUT)        # fanout
+    block = links + _lenprefixed(b"\x0a", unixfs)
+    node = DagNode(cidv0(block), len(block), len(block) + tsize_children,
+                   sum(n.content_size for _, n in items))
+    if sink is not None:
+        sink(node.cid, block)
+    return node
+
+
+def dag_of_directory(entries: dict[str, bytes], sink=None) -> DagNode:
     """UnixFS directory over named files, links sorted by name (go-ipfs).
 
     This is the wrapWithDirectory=true root the miner submits as the
     solution CID (`miner/src/ipfs.ts:42-47` extracts the wrapping root).
-    """
+    Directories whose flat block would exceed 256 KiB are HAMT-sharded
+    exactly as kubo auto-shards them (HAMTShardingSize), so huge output
+    sets still produce daemon-parity CIDs. `sink(cid, block)`, when
+    given, receives every directory-level block (for content stores)."""
     for name in entries:
         if "/" in name:
             # the daemon would treat this as a nested path, not a flat name
             raise ValueError(f"directory entry name may not contain '/': {name!r}")
     children = {name: dag_of_file(data) for name, data in entries.items()}
+    # kubo's auto-shard trigger is its ESTIMATED directory size — per
+    # entry len(name) + len(cid bytes), no protobuf framing or Tsize
+    # varints (go-unixfs io.BasicDirectory estimatedSize vs
+    # HAMTShardingSize = 256 KiB) — not the serialized block length.
+    # Matching the estimate matters near the boundary: a directory the
+    # daemon keeps flat must stay flat here or the solution CID diverges.
+    estimate = sum(len(name.encode("utf-8")) + len(node.cid)
+                   for name, node in children.items())
+    if estimate > CHUNK_SIZE:
+        return _hamt_shard(sorted(children.items()), 0, sink)
     links = b"".join(_pblink(children[name], name) for name in sorted(children))
     unixfs = b"\x08\x01"
     block = links + _lenprefixed(b"\x0a", unixfs)
-    if len(block) > CHUNK_SIZE:
-        # kubo auto-shards (HAMT) directories whose block exceeds 256 KiB;
-        # we don't implement HAMT sharding, so refuse rather than silently
-        # diverge from daemon parity. Model outputs are a handful of files.
-        raise NotImplementedError(
-            "directory block exceeds 256 KiB; HAMT sharding not implemented")
     tsize = len(block) + sum(c.tsize for c in children.values())
     dirsize = sum(c.content_size for c in children.values())
-    return DagNode(cidv0(block), len(block), tsize, dirsize)
+    node = DagNode(cidv0(block), len(block), tsize, dirsize)
+    if sink is not None:
+        sink(node.cid, block)
+    return node
 
 
 def cid_of_solution_files(files: dict[str, bytes]) -> bytes:

@@ -3,7 +3,7 @@
 The reference scales by "one miner process per GPU" (docs/src/pages/
 mining.mdx:7 — single GPU only) with no intra-model parallelism of any
 kind (SURVEY.md §2.6). This package is the TPU-native replacement: a
-declarative mesh (dp / tp / sp axes) over which pjit/shard_map place the
+declarative mesh (pp / dp / tp / sp axes) over which pjit/shard_map place the
 diffusion workloads, with XLA collectives riding ICI within a slice and
 DCN across hosts.
 
@@ -14,6 +14,9 @@ Axes:
        models whose activations exceed one chip's HBM.
   sp — sequence/context parallel: video frame axis for UNet3D temporal
        layers, spatial token axis for ring attention.
+  pp — pipeline parallel: layer-stack stages streamed with microbatches
+       (parallel/pipeline.py), point-to-point hand-offs on the
+       outermost axis so they may ride DCN.
 """
 from arbius_tpu.parallel.mesh import (
     MeshSpec,
@@ -33,6 +36,7 @@ from arbius_tpu.parallel.collectives import (
     ring_pass,
 )
 from arbius_tpu.parallel.distributed import initialize_distributed
+from arbius_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
 __all__ = [
     "DEFAULT_TP_RULES",
@@ -47,4 +51,6 @@ __all__ = [
     "halo_exchange",
     "ring_pass",
     "initialize_distributed",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

@@ -15,8 +15,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-# Canonical axis order, outermost -> innermost.
-AXIS_ORDER = ("dp", "sp", "tp")
+# Canonical axis order, outermost -> innermost. pp sits outermost: pipeline
+# stage hand-offs are point-to-point and low-volume, so they tolerate the
+# weakest links (DCN across hosts) while tp keeps the strongest (ICI).
+AXIS_ORDER = ("pp", "dp", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -26,9 +28,10 @@ class MeshSpec:
     dp: int = -1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
 
     def resolve(self, n_devices: int) -> dict[str, int]:
-        sizes = {"dp": self.dp, "sp": self.sp, "tp": self.tp}
+        sizes = {"pp": self.pp, "dp": self.dp, "sp": self.sp, "tp": self.tp}
         bad = {k: v for k, v in sizes.items() if v < 1 and v != -1}
         if bad:
             raise ValueError(f"axis sizes must be >= 1 (or -1 wildcard): {bad}")

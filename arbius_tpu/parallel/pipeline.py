@@ -1,0 +1,90 @@
+"""Pipeline parallelism over the mesh's `pp` axis (GPipe-style, inference).
+
+The reference has no intra-model parallelism at all (SURVEY.md §2.6 —
+one miner process per GPU); pp is part of this framework's TPU-native
+scaling vocabulary alongside dp/tp/sp. The construct here is the
+inference form of pipelining: a stack of identical-signature stages
+(e.g. a transformer's layer groups, or a diffusion UNet split at its
+level boundaries) laid out one-per-`pp`-shard, with microbatches
+streamed through the ring.
+
+Schedule (classic GPipe fill/drain): with S stages and M microbatches,
+step t has stage s working microbatch m = t - s when 0 ≤ m < M; results
+hop to stage s+1 via `lax.ppermute` (point-to-point — the reason pp is
+the outermost mesh axis and may ride DCN). Total steps M + S - 1; bubble
+fraction (S-1)/(M+S-1), amortized by choosing M ≥ S.
+
+Everything runs inside one `shard_map`-ed XLA program: the scan over
+steps is compiled control flow, the hand-off is a collective, and dp/tp
+axes compose — batch-inside-microbatch may shard over dp while each
+stage's params shard over tp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(param_trees: list) -> dict:
+    """Stack per-stage param trees along a leading stage axis (the layout
+    `pipeline_apply` shards over pp)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *param_trees)
+
+
+def pipeline_apply(fn, stacked_params, x, mesh, *, axis: str = "pp",
+                   microbatches: int | None = None,
+                   batch_axis: str | None = None):
+    """Run `fn(stage_params, h) -> h` through every pp stage, pipelined.
+
+    stacked_params: tree with leading stage axis of size mesh.shape[axis]
+    (see `stack_stage_params`); every stage must map activations of the
+    same shape (layer-stack pipelining). x: [B, ...]; B must divide into
+    `microbatches` (default: the stage count). With `batch_axis`, the
+    within-microbatch batch dim additionally shards over that mesh axis
+    (pp×dp composition). Returns fn applied stage-by-stage to x, exactly
+    equal to the sequential composition."""
+    S = mesh.shape[axis]
+    M = microbatches if microbatches is not None else S
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    mb = x.reshape(M, B // M, *x.shape[1:])
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    mb_spec = P(None, batch_axis) if batch_axis else P()
+
+    def run(params_local, mb_local):
+        # shard_map hands each stage its params with a leading length-1
+        # stage axis — drop it
+        params = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        s = lax.axis_index(axis)
+
+        def step(carry, t):
+            incoming, outs = carry
+            m = t - s                      # microbatch at this stage now
+            x_in = jnp.where(s == 0, mb_local[jnp.clip(t, 0, M - 1)],
+                             incoming)
+            y = fn(params, x_in)
+            shifted = lax.ppermute(y, axis, perm)
+            # the LAST stage finishes microbatch m = t - (S-1) at step t
+            done = t - (S - 1)
+            idx = jnp.clip(done, 0, M - 1)
+            valid = (s == S - 1) & (done >= 0) & (done < M)
+            outs = outs.at[idx].set(
+                jnp.where(valid, y, outs[idx]))
+            return (shifted, outs), None
+
+        init = (jnp.zeros_like(mb_local[0]), jnp.zeros_like(mb_local))
+        (_, outs), _ = lax.scan(step, init, jnp.arange(M + S - 1))
+        # results live on the last stage only; broadcast along pp
+        return lax.psum(jnp.where(s == S - 1, outs, 0), axis)
+
+    out = shard_map(
+        run, mesh=mesh,
+        in_specs=(P(axis), mb_spec),
+        out_specs=mb_spec,
+        check_rep=False)(stacked_params, mb)
+    return out.reshape(B, *x.shape[1:])

@@ -258,11 +258,103 @@ class TestDirectoryGuards:
         with pytest.raises(ValueError):
             dag_of_directory({"a/b.png": b"x"})
 
-    def test_oversized_directory_block_rejected(self):
-        # >256 KiB of link data would trigger kubo HAMT sharding
+
+def _parse_pbnode(block: bytes):
+    """Minimal dag-pb reader: → (links [(cid, name, tsize)], data bytes)."""
+    links, data, i = [], b"", 0
+    while i < len(block):
+        tag = block[i]
+        i += 1
+        ln, used = decode_varint(block[i:])
+        i += used
+        payload = block[i:i + ln]
+        i += ln
+        if tag == 0x0A:
+            data = payload
+        elif tag == 0x12:
+            cid, name, tsize, j = b"", "", 0, 0
+            while j < len(payload):
+                t2 = payload[j]
+                j += 1
+                if t2 == 0x18:
+                    tsize, used = decode_varint(payload[j:])
+                    j += used
+                    continue
+                l2, used = decode_varint(payload[j:])
+                j += used
+                if t2 == 0x0A:
+                    cid = payload[j:j + l2]
+                elif t2 == 0x12:
+                    name = payload[j:j + l2].decode()
+                j += l2
+            links.append((cid, name, tsize))
+    return links, data
+
+
+class TestHamtSharding:
+    """kubo auto-shards >256 KiB directory blocks into a murmur3/fanout-256
+    HAMT (go-unixfs); the sharded root must be deterministic and every
+    entry reachable through hex-prefixed shard links."""
+
+    def test_murmur3_reference_vectors(self):
+        from arbius_tpu.l0.murmur3 import hamt_hash, murmur3_x64_128
+
+        assert murmur3_x64_128(b"") == (0, 0)
+        # the mmh3 library's documented hash64 vector (signed pair)
+        h1, h2 = murmur3_x64_128(b"foo")
+        assert h1 == (-2129773440516405919) % 2**64
+        assert h2 == 9128664383759220103
+        assert hamt_hash("foo") == h1.to_bytes(8, "big")
+
+    def test_small_directory_stays_flat(self):
+        blocks = {}
+        node = dag_of_directory({"out-1.png": b"x"},
+                                sink=lambda c, b: blocks.update({c: b}))
+        _, data = _parse_pbnode(blocks[node.cid])
+        assert data == b"\x08\x01"  # plain UnixFS Directory
+
+    def test_oversized_directory_shards_and_walks(self):
         files = {f"f{i:05d}.bin": bytes([i % 256]) for i in range(6000)}
-        with pytest.raises(NotImplementedError):
-            dag_of_directory(files)
+        blocks = {}
+        node = dag_of_directory(files, sink=lambda c, b: blocks.update({c: b}))
+        root_links, root_data = _parse_pbnode(blocks[node.cid])
+        # UnixFS: Type=5, bitfield, hashType=0x22 murmur3, fanout=256
+        assert root_data.startswith(b"\x08\x05")
+        assert root_data.endswith(b"\x28\x22\x30\x80\x02")
+        assert len(blocks[node.cid]) <= CHUNK_SIZE
+
+        # walk the shard tree: every entry name must be reachable exactly
+        # once under its 2-hex-uppercase slot prefixes
+        found = {}
+
+        def walk(cid):
+            links, data = _parse_pbnode(blocks[cid])
+            assert data.startswith(b"\x08\x05")
+            for child_cid, name, _ in links:
+                prefix, entry = name[:2], name[2:]
+                assert prefix == prefix.upper() and len(prefix) == 2
+                int(prefix, 16)
+                if entry:
+                    found[entry] = child_cid
+                else:
+                    walk(child_cid)
+
+        walk(node.cid)
+        assert set(found) == set(files)
+        # deterministic
+        again = dag_of_directory(files)
+        assert again.cid == node.cid and again.tsize == node.tsize
+
+    def test_shard_assignment_matches_name_hash(self):
+        from arbius_tpu.l0.murmur3 import hamt_hash
+
+        files = {f"f{i:05d}.bin": b"x" for i in range(6000)}
+        blocks = {}
+        node = dag_of_directory(files, sink=lambda c, b: blocks.update({c: b}))
+        links, _ = _parse_pbnode(blocks[node.cid])
+        for _, name, _ in links:
+            if len(name) > 2:  # direct entry: prefix must be hash byte 0
+                assert int(name[:2], 16) == hamt_hash(name[2:])[0]
 
 
 class TestCommitment:
@@ -298,3 +390,16 @@ class TestSeed:
     def test_accepts_bytes_and_int(self):
         assert taskid2seed(b"\x01\x00") == 256
         assert taskid2seed(256) == 256
+
+    def test_shard_trigger_is_kubo_estimate_not_block_size(self):
+        """kubo shards on Σ(len(name)+len(cid)) > 256 KiB — NOT on the
+        serialized block length, which is ~8-12 bytes/link larger. A
+        directory in between must stay flat (daemon parity)."""
+        # 5500 entries × (10-byte name + 34-byte cid) = 242 KB estimate
+        # (< 262144) but a ~300 KB serialized block (> 262144)
+        files = {f"g{i:05d}.bin": b"x" for i in range(5500)}
+        blocks = {}
+        node = dag_of_directory(files, sink=lambda c, b: blocks.update({c: b}))
+        _, data = _parse_pbnode(blocks[node.cid])
+        assert data == b"\x08\x01"          # flat UnixFS Directory
+        assert len(blocks[node.cid]) > CHUNK_SIZE  # block itself is larger
