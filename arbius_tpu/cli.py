@@ -210,6 +210,143 @@ def cmd_demo_mine(args) -> int:
     return 0
 
 
+def _load_torch_state_dict(path: str) -> dict:
+    """Published checkpoint file → flat {key: numpy} dict.
+
+    Accepts .safetensors or torch pickle (.bin/.pt/.pth, weights_only);
+    unwraps torch-hub style {'state_dict': ...} envelopes; bf16/fp16
+    tensors are upcast to f32 on BOTH paths (numpy has no bf16, and the
+    two distribution formats of the same weights must convert to the
+    same artifact)."""
+    import torch
+
+    if path.endswith(".safetensors"):
+        # torch-side loader: safetensors.numpy cannot represent bf16
+        from safetensors.torch import load_file
+
+        obj = load_file(path)
+    else:
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+        if isinstance(obj, dict) and "state_dict" in obj \
+                and isinstance(obj["state_dict"], dict):
+            obj = obj["state_dict"]
+    out = {}
+    for k, v in obj.items():
+        if isinstance(v, torch.Tensor):
+            v = v.detach()
+            if v.is_floating_point():
+                v = v.to(torch.float32)
+            out[k] = v.numpy()
+        else:
+            out[k] = v
+    return out
+
+
+def cmd_convert_checkpoint(args) -> int:
+    """Offline converter: published torch/safetensors checkpoints → the
+    orbax tree the node factory loads (`ModelConfig.checkpoint`). The
+    template tree comes from jax.eval_shape, so no params are ever
+    materialized — conversion is pure host-side numpy."""
+    import jax
+
+    from arbius_tpu.utils import force_cpu_devices, save_params
+
+    # host-side tool; never dial the TPU tunnel
+    force_cpu_devices(1, strict=False)
+    fam = args.family
+
+    def need(flag: str) -> dict:
+        v = getattr(args, flag)
+        if not v:
+            raise SystemExit(f"--{flag} is required for family {fam}")
+        return _load_torch_state_dict(v)
+
+    if fam == "anythingv3":
+        from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
+        from arbius_tpu.models.sd15.convert import (
+            convert_sd15_text,
+            convert_sd15_unet,
+            convert_sd15_vae,
+        )
+
+        cfg = SD15Config()
+        pipe = SD15Pipeline(cfg, tokenizer=ByteTokenizer())
+        tmpl = jax.eval_shape(lambda: pipe.init_params(seed=0))
+        params = {
+            "unet": convert_sd15_unet(need("unet"), tmpl["unet"]),
+            "vae": convert_sd15_vae(need("vae"), tmpl["vae"]),
+            "text": convert_sd15_text(need("text"), tmpl["text"],
+                                      cfg.text.heads,
+                                      cfg.text.width // cfg.text.heads),
+        }
+    elif fam in ("zeroscopev2xl", "damo"):
+        from arbius_tpu.models.sd15 import ByteTokenizer
+        from arbius_tpu.models.video import (
+            Text2VideoConfig,
+            Text2VideoPipeline,
+            convert_unet3d,
+        )
+        from arbius_tpu.models.video.convert import (
+            convert_video_text,
+            convert_video_vae,
+        )
+
+        cfg = Text2VideoConfig()
+        pipe = Text2VideoPipeline(cfg, tokenizer=ByteTokenizer())
+        tmpl = jax.eval_shape(lambda: pipe.init_params(seed=0))
+        params = {
+            "unet": convert_unet3d(need("unet"), tmpl["unet"]),
+            "vae": convert_video_vae(need("vae"), tmpl["vae"]),
+            "text": convert_video_text(need("text"), tmpl["text"],
+                                       cfg.text.heads,
+                                       cfg.text.width // cfg.text.heads),
+        }
+    elif fam == "kandinsky2":
+        from arbius_tpu.models.kandinsky2 import (
+            Kandinsky2Config,
+            Kandinsky2Pipeline,
+            convert_kandinsky2_decoder,
+            convert_kandinsky2_movq,
+            convert_kandinsky2_prior,
+            convert_kandinsky2_text_projection,
+        )
+        from arbius_tpu.models.sd15 import ByteTokenizer
+        from arbius_tpu.models.sd15.convert import convert_sd15_text
+
+        cfg = Kandinsky2Config()
+        pipe = Kandinsky2Pipeline(cfg, tokenizer=ByteTokenizer())
+        tmpl = jax.eval_shape(lambda: pipe.init_params(seed=0))
+        prior_tree, stats = convert_kandinsky2_prior(need("prior"),
+                                                     tmpl["prior"])
+        text_sd = need("text")
+        params = {
+            "prior": prior_tree,
+            "prior_stats": stats,
+            "decoder": convert_kandinsky2_decoder(need("decoder"),
+                                                  tmpl["decoder"]),
+            "movq": convert_kandinsky2_movq(need("movq"), tmpl["movq"]),
+            "text": convert_sd15_text(text_sd, tmpl["text"],
+                                      cfg.text.heads,
+                                      cfg.text.width // cfg.text.heads),
+            "text_proj": convert_kandinsky2_text_projection(
+                text_sd, tmpl["text_proj"]),
+        }
+    elif fam == "robust_video_matting":
+        from arbius_tpu.models.rvm import RVMPipeline, RVMPipelineConfig, convert_rvm
+
+        pipe = RVMPipeline(RVMPipelineConfig())
+        tmpl = jax.eval_shape(lambda: pipe.init_params(seed=0))
+        params = convert_rvm(need("weights"), tmpl)
+    else:
+        raise SystemExit(f"unknown family {fam!r}")
+
+    save_params(args.out, params)
+    n = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    print(json.dumps({"family": fam, "out": args.out,
+                      "param_count": int(n)}))
+    return 0
+
+
 def cmd_devnet(args) -> int:
     """Local chain world (setup_local.sh parity): funded devnet over HTTP
     with a registered model, ready for `node-run` against it."""
@@ -557,6 +694,18 @@ def main(argv=None) -> int:
     sp = sub.add_parser("demo-mine")
     sp.add_argument("--prompt", default="arbius test cat")
     sp.set_defaults(fn=cmd_demo_mine)
+    sp = sub.add_parser(
+        "convert-checkpoint",
+        help="published torch/safetensors weights -> factory orbax tree")
+    sp.add_argument("--family", required=True,
+                    choices=["anythingv3", "kandinsky2", "zeroscopev2xl",
+                             "damo", "robust_video_matting"])
+    sp.add_argument("--out", required=True, help="orbax output directory")
+    for comp in ("unet", "vae", "text", "prior", "decoder", "movq",
+                 "weights"):
+        sp.add_argument(f"--{comp}", help=f"{comp} checkpoint file")
+    sp.set_defaults(fn=cmd_convert_checkpoint)
+
     sp = sub.add_parser("devnet")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8545)

@@ -174,7 +174,9 @@ class GEGLU(nn.Module):
         # the tp shards and force a reshard before the elementwise gate.
         h = nn.Dense(self.dim_out, dtype=self.dtype, name="ff_val")(x)
         gate = nn.Dense(self.dim_out, dtype=self.dtype, name="ff_gate")(x)
-        return h * nn.gelu(gate)
+        # diffusers GEGLU gates with torch F.gelu's EXACT erf form;
+        # jax.nn.gelu defaults to the tanh approximation
+        return h * nn.gelu(gate, approximate=False)
 
 
 class TransformerBlock(nn.Module):

@@ -78,7 +78,7 @@ class PriorBlock(nn.Module):
                           qkv_bias=True, name="attn1")(h, mask=mask)
         h = nn.LayerNorm(dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
         h = nn.Dense(x.shape[-1] * 4, dtype=self.dtype, name="ff_in")(h)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)  # diffusers 'gelu' = exact erf
         h = nn.Dense(x.shape[-1], dtype=self.dtype, name="ff_out")(h)
         return x + h
 

@@ -47,7 +47,11 @@ class _EncoderLayer(nn.Module):
         x = x + h
         h = nn.LayerNorm(dtype=jnp.float32)(x).astype(dt)
         h = nn.Dense(self.cfg.width * 4, dtype=dt)(h)
-        h = quick_gelu(h) if self.cfg.act == "quick_gelu" else nn.gelu(h)
+        # "gelu" towers (OpenCLIP ViT-H/bigG) use torch nn.GELU's EXACT
+        # erf form; jax.nn.gelu defaults to the tanh approximation, which
+        # would drift converted-weight activations across 24 layers
+        h = (quick_gelu(h) if self.cfg.act == "quick_gelu"
+             else nn.gelu(h, approximate=False))
         h = nn.Dense(self.cfg.width, dtype=dt)(h)
         return x + h
 

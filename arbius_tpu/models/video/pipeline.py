@@ -42,7 +42,10 @@ from arbius_tpu.schedulers import get_sampler
 class Text2VideoConfig:
     unet: UNet3DConfig = UNet3DConfig()
     vae: VAEConfig = VAEConfig()
-    text: TextEncoderConfig = TextEncoderConfig(width=1024)
+    # published ModelScope/zeroscope text tower: OpenCLIP ViT-H-class —
+    # hidden 1024, 16 heads, 24 layers, plain gelu
+    text: TextEncoderConfig = TextEncoderConfig(width=1024, heads=16,
+                                                layers=24, act="gelu")
 
     @classmethod
     def tiny(cls, sp_axis: str | None = None) -> "Text2VideoConfig":

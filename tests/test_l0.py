@@ -345,6 +345,19 @@ class TestHamtSharding:
         again = dag_of_directory(files)
         assert again.cid == node.cid and again.tsize == node.tsize
 
+    def test_shard_trigger_is_kubo_estimate_not_block_size(self):
+        """kubo shards on Σ(len(name)+len(cid)) > 256 KiB — NOT on the
+        serialized block length, which is ~8-12 bytes/link larger. A
+        directory in between must stay flat (daemon parity)."""
+        # 5500 entries × (10-byte name + 34-byte cid) = 242 KB estimate
+        # (< 262144) but a ~300 KB serialized block (> 262144)
+        files = {f"g{i:05d}.bin": b"x" for i in range(5500)}
+        blocks = {}
+        node = dag_of_directory(files, sink=lambda c, b: blocks.update({c: b}))
+        _, data = _parse_pbnode(blocks[node.cid])
+        assert data == b"\x08\x01"          # flat UnixFS Directory
+        assert len(blocks[node.cid]) > CHUNK_SIZE  # block itself is larger
+
     def test_shard_assignment_matches_name_hash(self):
         from arbius_tpu.l0.murmur3 import hamt_hash
 
@@ -390,16 +403,3 @@ class TestSeed:
     def test_accepts_bytes_and_int(self):
         assert taskid2seed(b"\x01\x00") == 256
         assert taskid2seed(256) == 256
-
-    def test_shard_trigger_is_kubo_estimate_not_block_size(self):
-        """kubo shards on Σ(len(name)+len(cid)) > 256 KiB — NOT on the
-        serialized block length, which is ~8-12 bytes/link larger. A
-        directory in between must stay flat (daemon parity)."""
-        # 5500 entries × (10-byte name + 34-byte cid) = 242 KB estimate
-        # (< 262144) but a ~300 KB serialized block (> 262144)
-        files = {f"g{i:05d}.bin": b"x" for i in range(5500)}
-        blocks = {}
-        node = dag_of_directory(files, sink=lambda c, b: blocks.update({c: b}))
-        _, data = _parse_pbnode(blocks[node.cid])
-        assert data == b"\x08\x01"          # flat UnixFS Directory
-        assert len(blocks[node.cid]) > CHUNK_SIZE  # block itself is larger
