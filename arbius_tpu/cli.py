@@ -318,6 +318,10 @@ def cmd_convert_checkpoint(args) -> int:
         tmpl = jax.eval_shape(lambda: pipe.init_params(seed=0))
         prior_tree, stats = convert_kandinsky2_prior(need("prior"),
                                                      tmpl["prior"])
+        if tuple(stats.shape) != tuple(tmpl["prior_stats"].shape):
+            raise SystemExit(
+                f"prior clip stats shape {tuple(stats.shape)} != configured "
+                f"{tuple(tmpl['prior_stats'].shape)} — wrong prior variant")
         text_sd = need("text")
         params = {
             "prior": prior_tree,
@@ -344,6 +348,52 @@ def cmd_convert_checkpoint(args) -> int:
     n = sum(x.size for x in jax.tree_util.tree_leaves(params))
     print(json.dumps({"family": fam, "out": args.out,
                       "param_count": int(n)}))
+    return 0
+
+
+def cmd_record_golden(args) -> int:
+    """Compute a model's golden CID — the boot self-test vector
+    (`MinerNode.boot`) that pins the fleet's deterministic build, the TPU
+    analogue of the reference's hard-coded kandinsky CID
+    (miner/src/index.ts:984-1001, input {prompt:"arbius test cat",
+    seed:1337}). Run on the SAME platform the fleet mines on (the TPU
+    chip); the printed snippet drops into ModelConfig.golden."""
+    import os
+    import time
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from arbius_tpu.utils import force_cpu_devices
+
+        force_cpu_devices(1, strict=False)
+    import jax
+
+    from arbius_tpu.node.config import MiningConfig, ModelConfig
+    from arbius_tpu.node.factory import build_registry
+    from arbius_tpu.node.solver import solve_cid
+    from arbius_tpu.templates.engine import hydrate_input
+
+    raw = (json.loads(args.input) if args.input
+           else {"prompt": "arbius test cat", "negative_prompt": ""})
+    mid = args.model_id or "0x" + "00" * 32
+    mc = ModelConfig(
+        id=mid, template=args.template, tiny=args.tiny,
+        checkpoint=args.checkpoint,
+        tokenizer="clip_bpe" if args.vocab else "byte",
+        vocab_path=args.vocab, merges_path=args.merges)
+    reg = build_registry(MiningConfig(models=(mc,)))
+    m = reg.get(mid)
+    if m is None:
+        raise SystemExit(f"template {args.template!r} needs node context "
+                         "(file inputs); record its golden via a node run")
+    hydrated = hydrate_input(dict(raw), m.template)
+    platform = jax.devices()[0].platform
+    t0 = time.perf_counter()
+    cid, _files = solve_cid(m, hydrated, args.seed)
+    print(json.dumps({
+        "template": args.template, "platform": platform,
+        "tiny": args.tiny, "elapsed_s": round(time.perf_counter() - t0, 1),
+        "golden": {"input": raw, "seed": args.seed, "cid": cid},
+    }))
     return 0
 
 
@@ -705,6 +755,22 @@ def main(argv=None) -> int:
                  "weights"):
         sp.add_argument(f"--{comp}", help=f"{comp} checkpoint file")
     sp.set_defaults(fn=cmd_convert_checkpoint)
+
+    sp = sub.add_parser(
+        "record-golden",
+        help="compute a model's boot self-test golden CID on this platform")
+    sp.add_argument("--template", required=True,
+                    choices=["anythingv3", "kandinsky2", "zeroscopev2xl",
+                             "damo"])  # file-input templates need a node
+    sp.add_argument("--input", help='hydratable input JSON (default: '
+                                    '{"prompt": "arbius test cat", ...})')
+    sp.add_argument("--seed", type=int, default=1337)  # index.ts:988
+    sp.add_argument("--tiny", action="store_true")
+    sp.add_argument("--checkpoint", help="orbax params (default: random init)")
+    sp.add_argument("--model-id", dest="model_id")
+    sp.add_argument("--vocab", help="CLIP BPE vocab.json (selects clip_bpe)")
+    sp.add_argument("--merges", help="CLIP BPE merges.txt")
+    sp.set_defaults(fn=cmd_record_golden)
 
     sp = sub.add_parser("devnet")
     sp.add_argument("--host", default="127.0.0.1")

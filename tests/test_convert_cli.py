@@ -51,3 +51,37 @@ def test_missing_component_is_clean_error(tmp_path):
     with pytest.raises(SystemExit, match="--weights is required"):
         main(["convert-checkpoint", "--family", "robust_video_matting",
               "--out", str(tmp_path / "x")])
+
+
+def test_record_golden_reproducible_and_boot_wirable(capsys):
+    """record-golden output must reproduce bit-exactly and drop into
+    ModelConfig.golden, where the factory wires it for boot's self-test
+    (the reference's pinned-CID check, index.ts:984-1001)."""
+    argv = ["record-golden", "--template", "anythingv3", "--tiny",
+            "--input", json.dumps({
+                "prompt": "arbius test cat", "negative_prompt": "",
+                "width": 128, "height": 128, "num_inference_steps": 2,
+                "scheduler": "DDIM"})]
+    assert main(argv) == 0
+    rec1 = json.loads(capsys.readouterr().out.strip())
+    assert main(argv) == 0
+    rec2 = json.loads(capsys.readouterr().out.strip())
+    assert rec1["golden"] == rec2["golden"]          # bit-stable
+    assert rec1["golden"]["cid"].startswith("0x1220")
+    assert rec1["golden"]["seed"] == 1337            # index.ts:988
+
+    # the snippet drops straight into config → factory → boot self-test
+    from arbius_tpu.node.config import MiningConfig, ModelConfig
+    from arbius_tpu.node.factory import build_registry
+    from arbius_tpu.node.solver import solve_cid
+    from arbius_tpu.templates.engine import hydrate_input
+
+    mid = "0x" + "ab" * 32
+    cfg = MiningConfig(models=(ModelConfig(
+        id=mid, template="anythingv3", tiny=True,
+        golden=rec1["golden"]),))
+    reg = build_registry(cfg)
+    m = reg.get(mid)
+    inp, seed, expected = m.golden
+    got, _ = solve_cid(m, hydrate_input(dict(inp), m.template), seed)
+    assert got == expected                            # boot would pass
