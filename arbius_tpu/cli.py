@@ -146,16 +146,20 @@ def cmd_emission(args) -> int:
     return 0
 
 
-def cmd_demo_mine(args) -> int:
+def _maybe_force_cpu() -> None:
+    """Honor a deliberate JAX_PLATFORMS=cpu run: the deployment's axon
+    plugin monkeypatches backend lookup and would dial the remote-TPU
+    tunnel regardless of the env var (hanging when it's unhealthy)."""
     import os
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        # honor a deliberate CPU run: the deployment's axon plugin
-        # monkeypatches backend lookup and would dial the remote-TPU
-        # tunnel regardless of the env var (hanging when it's unhealthy)
         from arbius_tpu.utils import force_cpu_devices
 
         force_cpu_devices(1, strict=False)
+
+
+def cmd_demo_mine(args) -> int:
+    _maybe_force_cpu()
     from arbius_tpu.chain import Engine, TokenLedger, WAD
     from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
     from arbius_tpu.node import (
@@ -358,13 +362,9 @@ def cmd_record_golden(args) -> int:
     (miner/src/index.ts:984-1001, input {prompt:"arbius test cat",
     seed:1337}). Run on the SAME platform the fleet mines on (the TPU
     chip); the printed snippet drops into ModelConfig.golden."""
-    import os
     import time
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        from arbius_tpu.utils import force_cpu_devices
-
-        force_cpu_devices(1, strict=False)
+    _maybe_force_cpu()
     import jax
 
     from arbius_tpu.node.config import MiningConfig, ModelConfig
@@ -378,20 +378,18 @@ def cmd_record_golden(args) -> int:
     mc = ModelConfig(
         id=mid, template=args.template, tiny=args.tiny,
         checkpoint=args.checkpoint,
+        weights_dtype=args.weights_dtype,
         tokenizer="clip_bpe" if args.vocab else "byte",
         vocab_path=args.vocab, merges_path=args.merges)
-    reg = build_registry(MiningConfig(models=(mc,)))
-    m = reg.get(mid)
-    if m is None:
-        raise SystemExit(f"template {args.template!r} needs node context "
-                         "(file inputs); record its golden via a node run")
+    m = build_registry(MiningConfig(models=(mc,))).get(mid)
     hydrated = hydrate_input(dict(raw), m.template)
     platform = jax.devices()[0].platform
     t0 = time.perf_counter()
     cid, _files = solve_cid(m, hydrated, args.seed)
     print(json.dumps({
         "template": args.template, "platform": platform,
-        "tiny": args.tiny, "elapsed_s": round(time.perf_counter() - t0, 1),
+        "tiny": args.tiny, "weights_dtype": args.weights_dtype,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
         "golden": {"input": raw, "seed": args.seed, "cid": cid},
     }))
     return 0
@@ -767,6 +765,10 @@ def main(argv=None) -> int:
     sp.add_argument("--seed", type=int, default=1337)  # index.ts:988
     sp.add_argument("--tiny", action="store_true")
     sp.add_argument("--checkpoint", help="orbax params (default: random init)")
+    sp.add_argument("--weights-dtype", dest="weights_dtype",
+                    default="float32", choices=["float32", "bfloat16"],
+                    help="goldens are dtype-specific: record with the "
+                         "fleet's production weights dtype")
     sp.add_argument("--model-id", dest="model_id")
     sp.add_argument("--vocab", help="CLIP BPE vocab.json (selects clip_bpe)")
     sp.add_argument("--merges", help="CLIP BPE merges.txt")

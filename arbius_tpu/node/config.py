@@ -32,12 +32,18 @@ class ModelConfig:
     tokenizer: str = "byte"
     vocab_path: str | None = None
     merges_path: str | None = None
+    # weights dtype on-device: bfloat16 halves HBM weight traffic (the
+    # reference's fp16-container trade); goldens are dtype-specific
+    weights_dtype: str = "float32"
     # boot self-test golden vector: {"input": {...}, "seed": int,
     # "cid": "0x1220..."} — the TPU fleet's analogue of the reference's
     # pinned kandinsky CID (miner/src/index.ts:989-999)
     golden: dict | None = None
 
     def __post_init__(self):
+        if self.weights_dtype not in ("float32", "bfloat16"):
+            raise ConfigError(f"model {self.id}: unknown weights_dtype "
+                              f"{self.weights_dtype!r}")
         if self.tokenizer not in ("byte", "clip_bpe"):
             raise ConfigError(f"model {self.id}: unknown tokenizer "
                               f"{self.tokenizer!r}")

@@ -30,10 +30,20 @@ def _params_for(pipe, m: ModelConfig):
     if m.checkpoint:
         from arbius_tpu.utils import load_params
 
-        return load_params(m.checkpoint)
-    log.warning("model %s: no checkpoint configured, using random init",
-                m.id)
-    return pipe.init_params(seed=0)
+        params = load_params(m.checkpoint)
+    else:
+        log.warning("model %s: no checkpoint configured, using random init",
+                    m.id)
+        params = pipe.init_params(seed=0)
+    if m.weights_dtype == "bfloat16":
+        import jax
+
+        from arbius_tpu.utils import cast_floating
+
+        # one jitted program: eager per-leaf casts would dispatch one op
+        # per leaf over a remote-TPU transport (the round-2 failure mode)
+        params = jax.jit(lambda p: cast_floating(p, "bfloat16"))(params)
+    return params
 
 
 def _tokenizer_for(m: ModelConfig, text_cfg):

@@ -1,9 +1,11 @@
 """Shared utilities: parameter checkpointing, compile-cache setup, platform forcing."""
 from arbius_tpu.utils.checkpoint import (
+    cast_floating,
     enable_compile_cache,
     load_params,
     save_params,
 )
 from arbius_tpu.utils.platform import force_cpu_devices
 
-__all__ = ["enable_compile_cache", "force_cpu_devices", "load_params", "save_params"]
+__all__ = ["cast_floating", "enable_compile_cache", "force_cpu_devices",
+           "load_params", "save_params"]

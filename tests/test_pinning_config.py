@@ -258,3 +258,28 @@ def test_factory_wires_golden_vector():
     m = reg.get("0x" + "22" * 32)
     assert m.golden == ({"prompt": "arbius test cat"}, 1337,
                         "0x1220" + "cd" * 32)
+
+
+def test_weights_dtype_validated_and_applied():
+    """weights_dtype=bfloat16 casts every floating leaf of the factory's
+    params (the fp16-container trade, TPU form); bad values reject."""
+    import jax.numpy as jnp
+    import pytest
+
+    from arbius_tpu.node.config import ConfigError, MiningConfig, ModelConfig
+    from arbius_tpu.node.factory import build_registry
+
+    with pytest.raises(ConfigError, match="weights_dtype"):
+        ModelConfig(id="0x" + "00" * 32, template="anythingv3",
+                    weights_dtype="fp8")
+
+    mid = "0x" + "cd" * 32
+    cfg = MiningConfig(models=(ModelConfig(
+        id=mid, template="anythingv3", tiny=True,
+        weights_dtype="bfloat16"),))
+    runner = build_registry(cfg).get(mid).runner
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(runner.params)
+    assert all(leaf.dtype == jnp.bfloat16
+               for leaf in leaves if jnp.issubdtype(leaf.dtype, jnp.inexact))
