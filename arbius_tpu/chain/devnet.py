@@ -136,6 +136,9 @@ class DevnetNode:
                     "registerModel(address,uint256,bytes)"):
             types = sig[sig.index("(") + 1:-1].split(",")
             self._engine_writes[_selector(sig)] = (types, dispatch(sig))
+        # treasury sweep (EngineV1.sol:544-552) — no arguments
+        self._engine_writes[_selector("withdrawAccruedFees()")] = (
+            [], lambda s, v: eng.withdraw_accrued_fees())
 
         self._token_writes = {
             _selector("approve(address,uint256)"): (
@@ -203,6 +206,8 @@ class DevnetNode:
                 ["bytes32"], lambda s, v: self.governor.queue(v[0])),
             _selector("execute(bytes32)"): (
                 ["bytes32"], lambda s, v: self.governor.execute(v[0])),
+            _selector("cancel(bytes32)"): (
+                ["bytes32"], lambda s, v: self.governor.cancel(s, v[0])),
         }
 
         def _gov_proposal(pid: bytes):
@@ -259,6 +264,10 @@ class DevnetNode:
                     if m else [0, "0x" + "00" * 20, 0, b""])
 
         self._engine_views = {
+            _selector("accruedFees()"): (
+                [], ["uint256"], lambda v: [eng.accrued_fees]),
+            _selector("treasury()"): (
+                [], ["address"], lambda v: [eng.treasury]),
             _selector("models(bytes32)"): (
                 ["bytes32"], ["uint256", "address", "uint256", "bytes"],
                 _model),

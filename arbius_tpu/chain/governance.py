@@ -34,6 +34,7 @@ TIMELOCK_MIN_DELAY = 60   # seconds (TimelockV1 deploy arg in scripts)
 class ProposalState(enum.Enum):
     PENDING = 0
     ACTIVE = 1
+    CANCELED = 2
     DEFEATED = 3
     SUCCEEDED = 4
     QUEUED = 5
@@ -58,6 +59,7 @@ class Proposal:
     abstain_votes: int = 0
     eta: int | None = None
     executed: bool = False
+    canceled: bool = False
     executed_actions: int = 0   # progress cursor for failure-safe retry
     voted: set = field(default_factory=set)
 
@@ -96,6 +98,8 @@ class Governor:
 
     def state(self, pid: bytes) -> ProposalState:
         p = self._get(pid)
+        if p.canceled:
+            return ProposalState.CANCELED
         if p.executed:
             return ProposalState.EXECUTED
         if p.eta is not None:
@@ -158,6 +162,17 @@ class Governor:
         self.engine._emit("VoteCast", voter=sender, id=pid,
                           support=support, weight=weight)
         return weight
+
+    def cancel(self, sender: str, pid: bytes) -> None:
+        """OZ Governor.cancel: only the proposer, only while PENDING
+        (before the vote snapshot)."""
+        p = self._get(pid)
+        if sender.lower() != p.proposer:
+            raise GovernanceError("only proposer can cancel")
+        if self.state(pid) != ProposalState.PENDING:
+            raise GovernanceError("too late to cancel")
+        p.canceled = True
+        self.engine._emit("ProposalCanceled", id=pid)
 
     def queue(self, pid: bytes) -> int:
         if self.state(pid) != ProposalState.SUCCEEDED:

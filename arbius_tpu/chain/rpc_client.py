@@ -55,6 +55,7 @@ ENGINE_FNS = {
                          ["address", "uint256"]),
     "registerModel": ("registerModel(address,uint256,bytes)",
                       ["address", "uint256", "bytes"]),
+    "withdrawAccruedFees": ("withdrawAccruedFees()", []),
 }
 
 ENGINE_EVENTS = {

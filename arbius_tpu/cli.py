@@ -23,8 +23,13 @@ Ops verbs against an endpoint (--deployment + --key, signed txs):
   task-status       task/solution view (task/[taskid] page data)
   claim             mining:claimSolution
   balance           mining:balance
+  transfer          mining:transfer — signed ERC20 transfer
+  decode-tx         decode a raw signed EIP-1559 transaction (offline)
+  treasury-withdraw treasury:withdrawAccruedFees — sweep protocol fees
   timetravel        mine/timetravel — devnet blocks/seconds
-  governance …      delegate/propose/vote/queue/execute/proposal
+  governance …      delegate/propose/vote/queue/execute/cancel/proposal
+  convert-checkpoint published weights → factory orbax tree
+  record-golden     boot self-test golden CID on this platform
 
 Run: python -m arbius_tpu.cli <command> [...args]
 """
@@ -581,6 +586,55 @@ def cmd_balance(args) -> int:
     return 0
 
 
+def cmd_transfer(args) -> int:
+    """mining:transfer parity (contract/tasks/index.ts:76-87): signed
+    ERC20 transfer to an address."""
+    client, dep = _rpc_client(args)
+    amount = _wad(args.amount)
+    txhash = client.send_to(dep.token_address, "transfer(address,uint256)",
+                            ["address", "uint256"], [args.to, amount])
+    print(json.dumps({"txhash": txhash, "to": args.to,
+                      "amount_wad": str(amount)}))
+    return 0
+
+
+def cmd_decode_tx(args) -> int:
+    """decode-tx parity (contract/tasks/index.ts:24-34): parse a raw
+    signed EIP-1559 transaction and recover its sender."""
+    from arbius_tpu.chain.rlp import decode_signed_eip1559
+
+    raw = bytes.fromhex(args.raw.removeprefix("0x"))
+    d = decode_signed_eip1559(raw)
+    data = d.tx.data or b""
+    print(json.dumps({
+        "from": d.sender, "to": d.tx.to, "nonce": d.tx.nonce,
+        "chain_id": d.tx.chain_id, "value": str(d.tx.value),
+        "gas_limit": d.tx.gas_limit,
+        "max_fee_per_gas": str(d.tx.max_fee_per_gas),
+        "selector": "0x" + data[:4].hex() if len(data) >= 4 else None,
+        "data": "0x" + data.hex(),
+        "tx_hash": "0x" + d.tx_hash.hex(),
+    }))
+    return 0
+
+
+def cmd_treasury_withdraw(args) -> int:
+    """treasury:withdrawAccruedFees parity (contract/tasks/index.ts) —
+    sweep accrued protocol fees to the treasury address."""
+    from arbius_tpu.l0.abi import abi_decode
+
+    client, dep = _rpc_client(args)
+    # report the accrued amount OBSERVED BEFORE the send: the tx may
+    # still be pending on a real endpoint (no receipt wait here), so a
+    # post-send read would race the sweep and other accruals
+    accrued = abi_decode(["uint256"], client.eth_call("accruedFees()",
+                                                      [], []))[0]
+    txhash = client.send("withdrawAccruedFees", [])
+    print(json.dumps({"txhash": txhash,
+                      "accrued_wad_before": str(accrued)}))
+    return 0
+
+
 def cmd_timetravel(args) -> int:
     """timetravel/mine parity (contract/tasks/index.ts:36-47) against a
     devnet endpoint: advance chain seconds and/or mine blocks."""
@@ -649,7 +703,7 @@ def cmd_governance(args) -> int:
                                 [args.pid, args.support])
         print(json.dumps({"txhash": txhash}))
         return 0
-    if verb in ("queue", "execute"):
+    if verb in ("queue", "execute", "cancel"):
         txhash = client.send_to(gov, f"{verb}(bytes32)", ["bytes32"],
                                 [args.pid])
         print(json.dumps({"txhash": txhash}))
@@ -830,6 +884,22 @@ def main(argv=None) -> int:
     sp.add_argument("--address", help="default: wallet address")
     sp.set_defaults(fn=cmd_balance)
 
+    sp = sub.add_parser("transfer", help="signed ERC20 transfer")
+    add_rpc_args(sp)
+    sp.add_argument("--to", required=True)
+    sp.add_argument("--amount", required=True, help="AIUS decimal amount")
+    sp.set_defaults(fn=cmd_transfer)
+
+    sp = sub.add_parser("decode-tx",
+                        help="decode a raw signed EIP-1559 transaction")
+    sp.add_argument("raw", help="0x-prefixed raw tx hex")
+    sp.set_defaults(fn=cmd_decode_tx)
+
+    sp = sub.add_parser("treasury-withdraw",
+                        help="sweep accrued protocol fees to the treasury")
+    add_rpc_args(sp)
+    sp.set_defaults(fn=cmd_treasury_withdraw)
+
     sp = sub.add_parser("timetravel",
                         help="advance devnet time and/or mine blocks")
     sp.add_argument("--deployment", required=True)
@@ -849,7 +919,7 @@ def main(argv=None) -> int:
                     help='e.g. "setSolutionMineableRate(bytes32,uint256)"')
     gp.add_argument("--args", nargs="*", help="call arguments")
     gp.add_argument("--description", required=True)
-    for v in ("vote", "queue", "execute", "proposal"):
+    for v in ("vote", "queue", "execute", "cancel", "proposal"):
         gp = gsub.add_parser(v)
         add_rpc_args(gp, key_required=(v != "proposal"))
         gp.add_argument("--pid", required=True, help="0x proposal id")
