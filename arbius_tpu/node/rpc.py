@@ -54,6 +54,10 @@ class ControlRPC:
                         outer.history_html(self.path[len("/history/"):]))
                 elif self.path == "/api/tasks":
                     self._send(200, outer.recent_tasks())
+                elif self.path == "/api/models":
+                    self._send(200, outer.models_view())
+                elif self.path == "/models":
+                    self._send_html(outer.models_html())
                 elif self.path == "/api/jobs/get":
                     jobs = outer.node.db.get_jobs(now=2**62)
                     self._send(200, [{
@@ -294,6 +298,41 @@ class ControlRPC:
             "<table><tr><th>task</th><th>role</th><th>fee</th>"
             f"<th>status</th></tr>{body}</table></body></html>")
 
+    def models_view(self) -> list[dict]:
+        """Registered-model inventory (the reference dapp's models page,
+        `website/src/pages/models`): id, template meta, filters, golden."""
+        out = []
+        for mid in self.node.registry.ids():
+            m = self.node.registry.get(mid)
+            out.append({
+                "id": mid,
+                "template_title": m.template.title,
+                "outputs": [o.filename for o in m.template.outputs],
+                "min_fee": str(m.min_fee),
+                "allowed_owners": list(m.allowed_owners),
+                "has_golden": m.golden is not None,
+            })
+        return out
+
+    def models_html(self) -> str:
+        import html as _html
+
+        rows = "".join(
+            f"<tr><td><code>{m['id'][:22]}…</code></td>"
+            f"<td>{_html.escape(m['template_title'])}</td>"
+            f"<td>{_html.escape(', '.join(m['outputs']))}</td>"
+            f"<td>{m['min_fee']}</td>"
+            f"<td>{'✓' if m['has_golden'] else ''}</td></tr>"
+            for m in self.models_view())
+        return (
+            "<!doctype html><html><head><meta charset='utf-8'>"
+            "<title>models — arbius-tpu node</title>"
+            f"<style>{self._PAGE_STYLE}</style></head><body>"
+            "<h1>Registered models</h1>"
+            "<table><tr><th>id</th><th>template</th><th>outputs</th>"
+            f"<th>min fee</th><th>golden</th></tr>{rows}"
+            "</table><p><a href='/'>← explorer</a></p></body></html>")
+
     def explorer_html(self) -> str:
         """Single-page explorer (L5 parity: the reference ships a Next.js
         dapp; the node serves an equivalent local view of tasks,
@@ -347,7 +386,7 @@ class ControlRPC:
             "<title>arbius-tpu node</title>"
             f"<style>{self._PAGE_STYLE}</style></head><body>"
             f"<h1>arbius-tpu node <small><a href='/history/{addr}'>"
-            f"{addr}</a></small></h1>"
+            f"{addr}</a> · <a href='/models'>models</a></small></h1>"
             f"<h2>Metrics</h2><ul>{stats}</ul>{form}"
             "<h2>Recent tasks</h2><table><tr><th>task</th><th>model</th>"
             f"<th>fee</th><th>status</th><th>solution cid</th></tr>{rows}"

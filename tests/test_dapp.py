@@ -116,3 +116,16 @@ def test_explorer_has_submit_form_and_task_links(dapp):
     assert f"<option value='{mid}'>" in html
     assert f"/task/{res['taskid']}" in html  # rows link to task pages
     assert f"/history/{chain.address}" in html
+
+
+def test_models_page_and_api(dapp):
+    """Reference dapp's models page parity: /api/models inventory +
+    rendered /models view, linked from the explorer."""
+    eng, chain, node, rpc, mid = dapp
+    models = json.loads(_get_text(rpc.port, "/api/models"))
+    assert len(models) == len(node.registry.ids())
+    m = next(x for x in models if x["id"] == mid)
+    assert m["outputs"] and "template_title" in m and "min_fee" in m
+    html = _get_text(rpc.port, "/models")
+    assert "Registered models" in html and mid[:22] in html
+    assert "/models" in _get_text(rpc.port, "/")
