@@ -728,6 +728,7 @@ def cmd_governance(args) -> int:
 
 def cmd_node_run(args) -> int:
     """Run the miner against a real JSON-RPC endpoint (start.ts parity)."""
+    _maybe_force_cpu()
     from arbius_tpu.chain.rpc_client import EngineRpcClient, JsonRpcTransport
     from arbius_tpu.chain.wallet import Wallet
     from arbius_tpu.node import MinerNode, load_config
