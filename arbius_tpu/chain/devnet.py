@@ -139,6 +139,16 @@ class DevnetNode:
         # treasury sweep (EngineV1.sol:544-552) — no arguments
         self._engine_writes[_selector("withdrawAccruedFees()")] = (
             [], lambda s, v: eng.withdraw_accrued_fees())
+        # owner/pauser-gated admin surface (EngineV1.sol:266-306) — the
+        # direct form of the calls governance reaches via the timelock
+        self._engine_writes[_selector("setPaused(bool)")] = (
+            ["bool"], lambda s, v: eng.set_paused(v[0], sender=s))
+        self._engine_writes[_selector("setVersion(uint256)")] = (
+            ["uint256"], lambda s, v: eng.set_version(v[0], sender=s))
+        self._engine_writes[_selector("transferPauser(address)")] = (
+            ["address"], lambda s, v: eng.transfer_pauser(v[0], sender=s))
+        self._engine_writes[_selector("transferOwnership(address)")] = (
+            ["address"], lambda s, v: eng.transfer_ownership(v[0], sender=s))
 
         self._token_writes = {
             _selector("approve(address,uint256)"): (

@@ -113,10 +113,15 @@ class Engine:
     ADDRESS = "0x" + "e1" * 20
 
     def __init__(self, token: TokenLedger | None = None, treasury: str = "0x" + "77" * 20,
-                 start_time: int = 0):
+                 start_time: int = 0, owner: str | None = None):
         self.token = token or TokenLedger()
         self.token.block_fn = lambda: self.block_number
         self.treasury = _addr(treasury)
+        # owner/pauser roles (EngineV1.sol:73-74, both = deployer at init
+        # :246-247; production transfers them to the timelock). None =
+        # role checks disabled (in-process tests drive methods directly).
+        self.owner = _addr(owner) if owner else None
+        self.pauser = self.owner
         self.paused = False
         self.accrued_fees = 0
         self.prevhash = b"\x00" * 32
@@ -566,11 +571,39 @@ class Engine:
         self.token.transfer(self.ADDRESS, self.treasury, self.accrued_fees)
         self.accrued_fees = 0
 
-    def set_paused(self, paused: bool):
+    def _only(self, sender: str | None, role: str | None, name: str):
+        """onlyOwner/onlyPauser (EngineV1.sol:199-211). sender=None is the
+        in-process/timelock caller (unrestricted — the governance path's
+        implied msg.sender IS the authorized timelock); an RPC caller must
+        match the configured role, and an unconfigured role authorizes
+        nobody over RPC."""
+        if sender is None:
+            return
+        if role is None or _addr(sender) != role:
+            raise EngineError(f"not {name}")
+
+    def set_paused(self, paused: bool, *, sender: str | None = None):
+        self._only(sender, self.pauser, "pauser")
         self.paused = paused
         self._emit("PausedChanged", paused=paused)
 
-    def set_version(self, version: int):
+    def transfer_pauser(self, to: str, *, sender: str | None = None):
+        """EngineV1.sol:279-281."""
+        self._only(sender, self.owner, "owner")
+        self.pauser = _addr(to)
+        self._emit("PauserTransferred", to=self.pauser)
+
+    def transfer_ownership(self, to: str, *, sender: str | None = None):
+        """OwnableUpgradeable surface (EngineV1.sol:266): the zero
+        address is rejected — ownership would be irrecoverably burned."""
+        self._only(sender, self.owner, "owner")
+        if int(_addr(to)[2:], 16) == 0:
+            raise EngineError("new owner is the zero address")
+        self.owner = _addr(to)
+        self._emit("OwnershipTransferred", to=self.owner)
+
+    def set_version(self, version: int, *, sender: str | None = None):
+        self._only(sender, self.owner, "owner")
         self.version = version
         self._emit("VersionChanged", version=version)
 

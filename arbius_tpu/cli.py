@@ -63,7 +63,10 @@ def _wad(amount: str) -> int:
 def _abi_cli_value(typ: str, arg: str):
     """CLI string literal → abi_encode-ready value for one static type."""
     if typ.startswith(("uint", "int")):
-        return int(arg, 0)
+        try:
+            return int(arg, 0)
+        except ValueError:
+            raise SystemExit(f"bad integer literal {arg!r}")
     if typ == "bool":
         low = arg.lower()
         if low in ("true", "1"):
@@ -407,12 +410,15 @@ def cmd_devnet(args) -> int:
     from arbius_tpu.chain.devnet import DevnetNode
 
     tok = TokenLedger()
-    eng = Engine(tok, start_time=args.start_time)
+    owner = args.owner or (args.fund[0] if args.fund else None)
+    eng = Engine(tok, start_time=args.start_time, owner=owner)
     tok.mint(Engine.ADDRESS, 600_000 * WAD)
     node = DevnetNode(eng, chain_id=args.chain_id)
     for addr in args.fund or []:
         tok.mint(addr.lower(), 1000 * WAD)
         print(f"funded {addr} with 1000 AIUS")
+    if owner:
+        print(f"engine owner/pauser: {owner}")
     mid = eng.register_model("0x" + "01" * 20, "0x" + "01" * 20, 0,
                              b'{"meta":{"title":"devnet"}}')
     print(json.dumps({
@@ -635,6 +641,38 @@ def cmd_treasury_withdraw(args) -> int:
     return 0
 
 
+def cmd_engine_admin(args) -> int:
+    """engine:pause / admin:setVersion parity — owner/pauser-gated direct
+    admin calls (EngineV1.sol:266-306; governance reaches the same
+    surface via the timelock)."""
+    client, dep = _rpc_client(args)
+    if args.admin_verb == "pause":
+        paused = bool(_abi_cli_value("bool", args.value))
+        txhash = client.send_to(dep.engine_address, "setPaused(bool)",
+                                ["bool"], [int(paused)])
+        print(json.dumps({"txhash": txhash, "paused": paused}))
+    elif args.admin_verb == "set-version":
+        version = _abi_cli_value("uint256", args.value)
+        txhash = client.send_to(dep.engine_address, "setVersion(uint256)",
+                                ["uint256"], [version])
+        print(json.dumps({"txhash": txhash, "version": version}))
+    elif args.admin_verb == "transfer-pauser":
+        if not re.fullmatch(r"0x[0-9a-fA-F]{40}", args.value):
+            raise SystemExit(f"bad address {args.value!r}")
+        txhash = client.send_to(dep.engine_address,
+                                "transferPauser(address)", ["address"],
+                                [args.value])
+        print(json.dumps({"txhash": txhash, "pauser": args.value}))
+    else:  # transfer-ownership
+        if not re.fullmatch(r"0x[0-9a-fA-F]{40}", args.value):
+            raise SystemExit(f"bad address {args.value!r}")
+        txhash = client.send_to(dep.engine_address,
+                                "transferOwnership(address)", ["address"],
+                                [args.value])
+        print(json.dumps({"txhash": txhash, "owner": args.value}))
+    return 0
+
+
 def cmd_timetravel(args) -> int:
     """timetravel/mine parity (contract/tasks/index.ts:36-47) against a
     devnet endpoint: advance chain seconds and/or mine blocks."""
@@ -836,6 +874,8 @@ def main(argv=None) -> int:
     sp.add_argument("--start-time", type=int, default=1000)
     sp.add_argument("--fund", action="append",
                     help="address to mint 1000 AIUS to (repeatable)")
+    sp.add_argument("--owner", help="engine owner/pauser address "
+                                    "(default: first --fund address)")
     sp.set_defaults(fn=cmd_devnet)
     def add_rpc_args(sp, *, key_required=True):
         sp.add_argument("--deployment", required=True,
@@ -900,6 +940,15 @@ def main(argv=None) -> int:
                         help="sweep accrued protocol fees to the treasury")
     add_rpc_args(sp)
     sp.set_defaults(fn=cmd_treasury_withdraw)
+
+    sp = sub.add_parser("engine-admin",
+                        help="owner/pauser-gated engine admin calls")
+    sp.add_argument("admin_verb", choices=["pause", "set-version",
+                                           "transfer-pauser",
+                                           "transfer-ownership"])
+    sp.add_argument("value", help="bool / version / address")
+    add_rpc_args(sp)
+    sp.set_defaults(fn=cmd_engine_admin)
 
     sp = sub.add_parser("timetravel",
                         help="advance devnet time and/or mine blocks")

@@ -312,3 +312,49 @@ def test_governance_cancel(world, capsys):
 
     with pytest.raises(RpcError, match="not active"):
         main(["governance", "vote", *base, "--pid", pid, "--support", "1"])
+
+
+def test_engine_admin_owner_gated(world, capsys):
+    """engine:pause / setVersion parity: pauser/owner-gated direct admin
+    writes; unauthorized senders revert, unconfigured roles authorize
+    nobody over RPC."""
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    eng, dev, operator, miner, dep = world
+    op = ["--deployment", dep, "--key", "0x" + operator.private_key.hex()]
+    other = ["--deployment", dep, "--key", "0x" + miner.private_key.hex()]
+
+    # roles unconfigured: nobody may admin over RPC
+    with pytest.raises(RpcError, match="not pauser"):
+        main(["engine-admin", "pause", "true", *op])
+
+    eng.owner = eng.pauser = operator.address.lower()
+    out = run_cli(capsys, ["engine-admin", "pause", "true", *op])
+    assert out["paused"] is True and eng.paused is True
+    with pytest.raises(RpcError, match="not pauser"):
+        main(["engine-admin", "pause", "false", *other])
+    run_cli(capsys, ["engine-admin", "pause", "false", *op])
+    assert eng.paused is False
+
+    run_cli(capsys, ["engine-admin", "set-version", "3", *op])
+    assert eng.version == 3
+    with pytest.raises(RpcError, match="not owner"):
+        main(["engine-admin", "set-version", "4", *other])
+
+    # hand the pauser role to the miner; owner stays with the operator
+    run_cli(capsys, ["engine-admin", "transfer-pauser",
+                     miner.address, *op])
+    run_cli(capsys, ["engine-admin", "pause", "true", *other])
+    assert eng.paused is True
+
+
+def test_transfer_ownership_rejects_zero_address(world, capsys):
+    eng, dev, operator, miner, dep = world
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    eng.owner = eng.pauser = operator.address.lower()
+    op = ["--deployment", dep, "--key", "0x" + operator.private_key.hex()]
+    with pytest.raises(RpcError, match="zero address"):
+        main(["engine-admin", "transfer-ownership",
+              "0x" + "00" * 20, *op])
+    assert eng.owner == operator.address.lower()  # unchanged
