@@ -51,6 +51,9 @@ EVENT_ABI = {
         ("id", "bytes32", True), ("model", "bytes32", True),
         ("fee", "uint256", False), ("sender", "address", True)]),
     "TaskRetracted": ("TaskRetracted(bytes32)", [("id", "bytes32", True)]),
+    "SignalSupport": ("SignalSupport(address,bytes32,bool)", [
+        ("addr", "address", True), ("model", "bytes32", True),
+        ("support", "bool", False)]),
     "SignalCommitment": ("SignalCommitment(address,bytes32)", [
         ("addr", "address", True), ("commitment", "bytes32", True)]),
     "SolutionSubmitted": ("SolutionSubmitted(address,bytes32)", [
@@ -66,6 +69,10 @@ EVENT_ABI = {
         ("version", "uint256", False)]),
     "PausedChanged": ("PausedChanged(bool)", [
         ("paused", "bool", False)]),
+    "PauserTransferred": ("PauserTransferred(address)", [
+        ("to", "address", True)]),
+    "OwnershipTransferred": ("OwnershipTransferred(address)", [
+        ("to", "address", True)]),
     "ProposalCreated": ("ProposalCreated(bytes32,address)", [
         ("id", "bytes32", True), ("proposer", "address", True)]),
 }
@@ -122,6 +129,10 @@ class DevnetNode:
                     lambda s, v: eng.validator_deposit(s, v[0], v[1]),
                 "registerModel(address,uint256,bytes)":
                     lambda s, v: eng.register_model(s, v[0], v[1], v[2]),
+                "retractTask(bytes32)":
+                    lambda s, v: eng.retract_task(s, v[0]),
+                "signalSupport(bytes32,bool)":
+                    lambda s, v: eng.signal_support(s, v[0], v[1]),
             }[fn_name]
 
         self._engine_writes = {}
@@ -133,7 +144,9 @@ class DevnetNode:
                     "voteOnContestation(bytes32,bool)",
                     "contestationVoteFinish(bytes32,uint32)",
                     "validatorDeposit(address,uint256)",
-                    "registerModel(address,uint256,bytes)"):
+                    "registerModel(address,uint256,bytes)",
+                    "retractTask(bytes32)",
+                    "signalSupport(bytes32,bool)"):
             types = sig[sig.index("(") + 1:-1].split(",")
             self._engine_writes[_selector(sig)] = (types, dispatch(sig))
         # treasury sweep (EngineV1.sol:544-552) — no arguments
@@ -182,7 +195,15 @@ class DevnetNode:
                 ["bytes32", "uint256"],
                 lambda v: eng.set_solution_mineable_rate(v[0], v[1])),
             (self.engine_address, _selector("setPaused(bool)")): (
-                ["bool"], lambda v: eng.set_paused(v[0])),
+                # the timelock executes as the governor identity: with a
+                # configured pauser the role check applies to it exactly
+                # as EngineV1's onlyPauser would (production transfers the
+                # role to the timelock; a devnet that moved it elsewhere
+                # must see this revert); unconfigured roles keep the
+                # legacy unrestricted path
+                ["bool"], lambda v: eng.set_paused(
+                    v[0], sender=(self.governor_address
+                                  if eng.pauser is not None else None))),
         }
 
         def _gov_action(target: str, value: int, calldata: bytes):

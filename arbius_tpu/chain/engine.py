@@ -368,6 +368,15 @@ class Engine:
         del self.tasks[taskid]
         self._emit("TaskRetracted", id=taskid)
 
+    def signal_support(self, sender: str, model: bytes, support: bool):
+        """EngineV1.sol:775-781: validator-gated, event-only (indexer
+        convenience — lets miners advertise which models they serve)."""
+        self._only_validator(sender)
+        if model not in self.models:
+            raise EngineError("model does not exist")
+        self._emit("SignalSupport", addr=_addr(sender), model=model,
+                   support=support)
+
     # -- commit-reveal solutions -----------------------------------------
     def signal_commitment(self, sender: str, commitment: bytes):
         """EngineV1.sol:764-768: anyone may register, never reset."""

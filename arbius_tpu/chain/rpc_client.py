@@ -56,6 +56,8 @@ ENGINE_FNS = {
     "registerModel": ("registerModel(address,uint256,bytes)",
                       ["address", "uint256", "bytes"]),
     "withdrawAccruedFees": ("withdrawAccruedFees()", []),
+    "retractTask": ("retractTask(bytes32)", ["bytes32"]),
+    "signalSupport": ("signalSupport(bytes32,bool)", ["bytes32", "bool"]),
 }
 
 ENGINE_EVENTS = {

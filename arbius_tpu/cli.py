@@ -24,6 +24,8 @@ Ops verbs against an endpoint (--deployment + --key, signed txs):
   claim             mining:claimSolution
   balance           mining:balance
   transfer          mining:transfer — signed ERC20 transfer
+  task-retract      retractTask — owner reclaims unsolved task fee
+  signal-support    mining:signalSupport — validator model signal
   decode-tx         decode a raw signed EIP-1559 transaction (offline)
   treasury-withdraw treasury:withdrawAccruedFees — sweep protocol fees
   timetravel        mine/timetravel — devnet blocks/seconds
@@ -410,7 +412,9 @@ def cmd_devnet(args) -> int:
     from arbius_tpu.chain.devnet import DevnetNode
 
     tok = TokenLedger()
-    owner = args.owner or (args.fund[0] if args.fund else None)
+    owner = args.owner
+    if owner and not re.fullmatch(r"0x[0-9a-fA-F]{40}", owner):
+        raise SystemExit(f"bad owner address {owner!r}")
     eng = Engine(tok, start_time=args.start_time, owner=owner)
     tok.mint(Engine.ADDRESS, 600_000 * WAD)
     node = DevnetNode(eng, chain_id=args.chain_id)
@@ -673,6 +677,26 @@ def cmd_engine_admin(args) -> int:
     return 0
 
 
+def cmd_task_retract(args) -> int:
+    """retractTask: the task owner reclaims the fee (minus retraction
+    cut) after the wait period, while unsolved (EngineV1.sol:718-736)."""
+    client, dep = _rpc_client(args)
+    txhash = client.send("retractTask", [args.taskid])
+    print(json.dumps({"txhash": txhash, "taskid": args.taskid}))
+    return 0
+
+
+def cmd_signal_support(args) -> int:
+    """mining:signalSupport parity (contract/tasks/index.ts:96-103):
+    validator-gated, event-only model-support signal for indexers."""
+    client, dep = _rpc_client(args)
+    support = bool(_abi_cli_value("bool", args.support))
+    txhash = client.send("signalSupport", [args.model, int(support)])
+    print(json.dumps({"txhash": txhash, "model": args.model,
+                      "support": support}))
+    return 0
+
+
 def cmd_timetravel(args) -> int:
     """timetravel/mine parity (contract/tasks/index.ts:36-47) against a
     devnet endpoint: advance chain seconds and/or mine blocks."""
@@ -874,8 +898,10 @@ def main(argv=None) -> int:
     sp.add_argument("--start-time", type=int, default=1000)
     sp.add_argument("--fund", action="append",
                     help="address to mint 1000 AIUS to (repeatable)")
-    sp.add_argument("--owner", help="engine owner/pauser address "
-                                    "(default: first --fund address)")
+    sp.add_argument("--owner", help="engine owner/pauser address; unset "
+                                    "leaves roles unconfigured (direct "
+                                    "admin calls denied, governance path "
+                                    "unrestricted)")
     sp.set_defaults(fn=cmd_devnet)
     def add_rpc_args(sp, *, key_required=True):
         sp.add_argument("--deployment", required=True,
@@ -940,6 +966,19 @@ def main(argv=None) -> int:
                         help="sweep accrued protocol fees to the treasury")
     add_rpc_args(sp)
     sp.set_defaults(fn=cmd_treasury_withdraw)
+
+    sp = sub.add_parser("task-retract",
+                        help="owner reclaims an unsolved task's fee")
+    add_rpc_args(sp)
+    sp.add_argument("taskid", help="0x task id")
+    sp.set_defaults(fn=cmd_task_retract)
+
+    sp = sub.add_parser("signal-support",
+                        help="validator signals support for a model")
+    add_rpc_args(sp)
+    sp.add_argument("--model", required=True)
+    sp.add_argument("--support", default="true")
+    sp.set_defaults(fn=cmd_signal_support)
 
     sp = sub.add_parser("engine-admin",
                         help="owner/pauser-gated engine admin calls")

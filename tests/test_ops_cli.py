@@ -358,3 +358,100 @@ def test_transfer_ownership_rejects_zero_address(world, capsys):
         main(["engine-admin", "transfer-ownership",
               "0x" + "00" * 20, *op])
     assert eng.owner == operator.address.lower()  # unchanged
+
+
+def test_task_retract_and_signal_support(world, capsys):
+    """retractTask + mining:signalSupport parity over signed txs."""
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    eng, dev, operator, miner, dep = world
+    op = ["--deployment", dep, "--key", "0x" + operator.private_key.hex()]
+    mi = ["--deployment", dep, "--key", "0x" + miner.private_key.hex()]
+
+    reg = run_cli(capsys, ["model-register", *op,
+                           "--template", "anythingv3"])
+    mid = reg["model_id"]
+
+    # signal-support (validator gating itself is covered by the engine
+    # suite; this world's pseudo-supply keeps the minimum at zero)
+    with pytest.raises(RpcError, match="model does not exist"):
+        main(["signal-support", *mi, "--model", "0x" + "77" * 32])
+    run_cli(capsys, ["validator-stake", *mi])
+    out = run_cli(capsys, ["signal-support", *mi, "--model", mid,
+                           "--support", "true"])
+    assert out["support"] is True
+    assert eng.events[-1].name == "SignalSupport"
+    assert eng.events[-1].args["model"] == bytes.fromhex(mid[2:])
+
+    # retract: fee comes back minus the 10% retraction cut
+    sub = run_cli(capsys, ["task-submit", *op, "--model", mid,
+                           "--template", "anythingv3", "--fee", "10",
+                           "--input", json.dumps({
+                               "prompt": "r", "negative_prompt": ""})])
+    tid = sub["taskid"]
+    with pytest.raises(RpcError, match="did not wait"):
+        main(["task-retract", *op, tid])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--seconds", "10001", "--blocks", "1"])
+    bal0 = int(run_cli(capsys, ["balance", "--deployment", dep,
+                                "--address", operator.address])
+               ["balance_wad"])
+    run_cli(capsys, ["task-retract", *op, tid])
+    bal1 = int(run_cli(capsys, ["balance", "--deployment", dep,
+                                "--address", operator.address])
+               ["balance_wad"])
+    assert bal1 - bal0 == 9 * WAD          # 10 minus 10% cut
+    assert eng.accrued_fees == 1 * WAD     # cut accrued to treasury
+
+
+def test_governance_pause_respects_transferred_pauser(world, capsys):
+    """EngineV1 fidelity: the timelock executes as the governor identity,
+    so once the pauser role moves elsewhere a governance setPaused must
+    revert exactly as onlyPauser would on-chain."""
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    eng, dev, operator, miner, dep = world
+    op = ["--deployment", dep, "--key", "0x" + operator.private_key.hex()]
+    # production posture: the timelock/governor holds the roles
+    eng.owner = eng.pauser = dev.governor_address
+    run_cli(capsys, ["governance", "delegate", *op])
+    run_cli(capsys, ["timetravel", "--deployment", dep, "--blocks", "1"])
+    prop = run_cli(capsys, ["governance", "propose", *op,
+                            "--fn", "setPaused(bool)", "--args", "true",
+                            "--description", "pause via timelock"])
+    pid = prop["proposal_id"]
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_DELAY + 1)])
+    run_cli(capsys, ["governance", "vote", *op, "--pid", pid,
+                     "--support", "1"])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_PERIOD + 1)])
+    run_cli(capsys, ["governance", "queue", *op, "--pid", pid])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--seconds", str(TIMELOCK_MIN_DELAY + 1),
+                     "--blocks", "1"])
+    # timelock holds pauser: executes
+    run_cli(capsys, ["governance", "execute", *op, "--pid", pid])
+    assert eng.paused is True
+    eng.paused = False
+
+    # move pauser away from the timelock; a second pause proposal must
+    # now revert at execution (proposal stays QUEUED)
+    eng.pauser = operator.address.lower()
+    prop2 = run_cli(capsys, ["governance", "propose", *op,
+                             "--fn", "setPaused(bool)", "--args", "true",
+                             "--description", "pause after handoff"])
+    pid2 = prop2["proposal_id"]
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_DELAY + 1)])
+    run_cli(capsys, ["governance", "vote", *op, "--pid", pid2,
+                     "--support", "1"])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_PERIOD + 1)])
+    run_cli(capsys, ["governance", "queue", *op, "--pid", pid2])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--seconds", str(TIMELOCK_MIN_DELAY + 1),
+                     "--blocks", "1"])
+    with pytest.raises(RpcError, match="not pauser"):
+        main(["governance", "execute", *op, "--pid", pid2])
+    assert eng.paused is False
