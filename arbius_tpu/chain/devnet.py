@@ -71,8 +71,9 @@ EVENT_ABI = {
         ("paused", "bool", False)]),
     "PauserTransferred": ("PauserTransferred(address)", [
         ("to", "address", True)]),
-    "OwnershipTransferred": ("OwnershipTransferred(address)", [
-        ("to", "address", True)]),
+    "OwnershipTransferred": (
+        "OwnershipTransferred(address,address)", [
+            ("previous", "address", True), ("to", "address", True)]),
     "ProposalCreated": ("ProposalCreated(bytes32,address)", [
         ("id", "bytes32", True), ("proposer", "address", True)]),
 }
@@ -192,8 +193,14 @@ class DevnetNode:
         self._timelock_calls = {
             (self.engine_address,
              _selector("setSolutionMineableRate(bytes32,uint256)")): (
+                # same timelock-identity rule as setPaused below: with a
+                # configured owner the onlyOwner check applies to the
+                # governor exactly as EngineV1.sol:293 would
                 ["bytes32", "uint256"],
-                lambda v: eng.set_solution_mineable_rate(v[0], v[1])),
+                lambda v: eng.set_solution_mineable_rate(
+                    v[0], v[1], sender=(self.governor_address
+                                        if eng.owner is not None
+                                        else None))),
             (self.engine_address, _selector("setPaused(bool)")): (
                 # the timelock executes as the governor identity: with a
                 # configured pauser the role check applies to it exactly

@@ -317,8 +317,11 @@ class Engine:
         self._emit("ModelRegistered", id=mid)
         return mid
 
-    def set_solution_mineable_rate(self, model: bytes, rate: int):
-        """EngineV1.sol:293-301 (governance-gated on-chain)."""
+    def set_solution_mineable_rate(self, model: bytes, rate: int,
+                                   *, sender: str | None = None):
+        """EngineV1.sol:293-301 (onlyOwner; governance reaches it with the
+        timelock as owner)."""
+        self._only(sender, self.owner, "owner")
         if model not in self.models:
             raise EngineError("model does not exist")
         self.models[model].rate = rate
@@ -608,8 +611,11 @@ class Engine:
         self._only(sender, self.owner, "owner")
         if int(_addr(to)[2:], 16) == 0:
             raise EngineError("new owner is the zero address")
+        prev = self.owner
         self.owner = _addr(to)
-        self._emit("OwnershipTransferred", to=self.owner)
+        # OZ OwnableUpgradeable event shape: (previousOwner, newOwner)
+        self._emit("OwnershipTransferred", previous=prev or ZERO,
+                   to=self.owner)
 
     def set_version(self, version: int, *, sender: str | None = None):
         self._only(sender, self.owner, "owner")

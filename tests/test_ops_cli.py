@@ -455,3 +455,40 @@ def test_governance_pause_respects_transferred_pauser(world, capsys):
     with pytest.raises(RpcError, match="not pauser"):
         main(["governance", "execute", *op, "--pid", pid2])
     assert eng.paused is False
+
+
+def test_governance_rate_respects_transferred_owner(world, capsys):
+    """Mirror of the pauser case for the owner role: once ownership moves
+    off the timelock, a governance setSolutionMineableRate must revert at
+    execution exactly as onlyOwner would on-chain."""
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    eng, dev, operator, miner, dep = world
+    op = ["--deployment", dep, "--key", "0x" + operator.private_key.hex()]
+    reg = run_cli(capsys, ["model-register", *op,
+                           "--template", "anythingv3"])
+    mid = reg["model_id"]
+    eng.owner = eng.pauser = operator.address.lower()  # NOT the timelock
+    run_cli(capsys, ["governance", "delegate", *op])
+    run_cli(capsys, ["timetravel", "--deployment", dep, "--blocks", "1"])
+    prop = run_cli(capsys, [
+        "governance", "propose", *op,
+        "--fn", "setSolutionMineableRate(bytes32,uint256)",
+        "--args", mid, "7", "--description", "rate sans ownership"])
+    pid = prop["proposal_id"]
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_DELAY + 1)])
+    run_cli(capsys, ["governance", "vote", *op, "--pid", pid,
+                     "--support", "1"])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--blocks", str(VOTING_PERIOD + 1)])
+    run_cli(capsys, ["governance", "queue", *op, "--pid", pid])
+    run_cli(capsys, ["timetravel", "--deployment", dep,
+                     "--seconds", str(TIMELOCK_MIN_DELAY + 1),
+                     "--blocks", "1"])
+    with pytest.raises(RpcError, match="not owner"):
+        main(["governance", "execute", *op, "--pid", pid])
+    # hand ownership to the timelock: the retry now applies
+    eng.owner = dev.governor_address
+    run_cli(capsys, ["governance", "execute", *op, "--pid", pid])
+    assert eng.models[bytes.fromhex(mid[2:])].rate == 7

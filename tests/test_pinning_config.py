@@ -283,3 +283,18 @@ def test_weights_dtype_validated_and_applied():
     leaves = jax.tree_util.tree_leaves(runner.params)
     assert all(leaf.dtype == jnp.bfloat16
                for leaf in leaves if jnp.issubdtype(leaf.dtype, jnp.inexact))
+
+
+def test_bundled_example_config_validates():
+    """MiningConfig.example.json (the reference ships one too) must parse
+    through the schema validator — it is the operator's starting point."""
+    import os
+
+    from arbius_tpu.node.config import load_config
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "MiningConfig.example.json")
+    cfg = load_config(open(path).read())
+    assert cfg.models and cfg.models[0].template == "anythingv3"
+    assert cfg.models[0].weights_dtype == "bfloat16"
+    assert cfg.models[0].golden is not None
