@@ -1,0 +1,77 @@
+"""Full-published-topology execution proofs — env-gated.
+
+The regular suite runs tiny configs (CI hosts); these tests run each
+family's FULL published topology end-to-end at small spatial/step counts
+(params are shape-independent, so this exercises every real channel
+width, head split, and converter-facing module on real trees: SD-1.5
+860M, Kandinsky-2 ~3.0B across prior/decoder/MOVQ/text, ModelScope-class
+UNet3D ~1.9B, RVM 3.8M). On a 1-core CPU host each diffusion family
+takes ~15-25 min to compile+run, so they are opt-in:
+
+    ARBIUS_FULL_TOPOLOGY=1 JAX_PLATFORMS=cpu python -m pytest \
+        tests/test_full_topology.py -q
+
+All four were executed green on 2026-07-30 (this round's working host).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = [
+    pytest.mark.slow, pytest.mark.model,
+    pytest.mark.skipif(not os.environ.get("ARBIUS_FULL_TOPOLOGY"),
+                       reason="set ARBIUS_FULL_TOPOLOGY=1 (each family "
+                              "compiles ~15-25 min on a 1-core host)"),
+]
+
+
+def _tok():
+    from arbius_tpu.models.sd15 import ByteTokenizer
+
+    return ByteTokenizer()
+
+
+def test_sd15_full_topology_generates():
+    from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline
+
+    pipe = SD15Pipeline(SD15Config(), tokenizer=_tok())
+    params = pipe.init_params(seed=0, height=128, width=128)
+    img = pipe.generate(params, ["arbius test cat"], [""], [1337],
+                        width=128, height=128, num_inference_steps=2,
+                        scheduler="DDIM")
+    assert img.shape == (1, 128, 128, 3) and img.dtype == np.uint8
+
+
+def test_kandinsky2_full_topology_generates():
+    from arbius_tpu.models.kandinsky2 import Kandinsky2Config, Kandinsky2Pipeline
+
+    pipe = Kandinsky2Pipeline(Kandinsky2Config(), tokenizer=_tok())
+    params = pipe.init_params(seed=0, height=128, width=128)
+    img = pipe.generate(params, ["arbius test cat"], [""], [1337],
+                        width=128, height=128, num_inference_steps=2)
+    assert img.shape == (1, 128, 128, 3) and img.dtype == np.uint8
+
+
+def test_video_full_topology_generates():
+    from arbius_tpu.models.video import Text2VideoConfig, Text2VideoPipeline
+
+    pipe = Text2VideoPipeline(Text2VideoConfig(), tokenizer=_tok())
+    params = pipe.init_params(seed=0)
+    v = pipe.generate(params, ["arbius test cat"], [""], [1337],
+                      num_frames=2, width=128, height=128,
+                      num_inference_steps=2, scheduler="DDIM")
+    assert v.shape == (1, 2, 128, 128, 3) and v.dtype == np.uint8
+
+
+def test_rvm_full_topology_mattes():
+    from arbius_tpu.models.rvm import RVMPipeline, RVMPipelineConfig
+
+    pipe = RVMPipeline(RVMPipelineConfig())
+    params = pipe.init_params(seed=0, height=64, width=64)
+    rng = np.random.default_rng(0)
+    video = rng.integers(0, 255, (2, 64, 64, 3), dtype=np.uint8)
+    out = pipe.matte(params, video, output_type="green-screen")
+    assert out.shape == video.shape and out.dtype == np.uint8
