@@ -1,9 +1,10 @@
-"""TPU op layer: ring attention (SP) and pallas kernels.
+"""TPU op layer: ring + Ulysses sequence parallelism and pallas kernels.
 
 Custom compute that XLA's default lowering doesn't give us: exact
 sequence-parallel attention over a mesh axis, and (ops.flash) a pallas
 flash-attention kernel for long single-device sequences.
 """
 from arbius_tpu.ops.ring import ring_attention, sp_attention_reference
+from arbius_tpu.ops.ulysses import ulysses_attention
 
-__all__ = ["ring_attention", "sp_attention_reference"]
+__all__ = ["ring_attention", "sp_attention_reference", "ulysses_attention"]

@@ -1,0 +1,61 @@
+"""Ulysses-style all-to-all sequence parallelism over a named mesh axis.
+
+The second of the two first-class long-context strategies (alongside
+`ops/ring.py`): instead of rotating K/V shards around a ring, ONE
+`all_to_all` re-shards the layout from sequence-sharded to head-sharded,
+every head group then attends over the FULL sequence locally, and a
+second `all_to_all` restores sequence sharding (the DeepSpeed-Ulysses
+communication pattern).
+
+Trade-off vs ring: two all-to-alls of activation size total (cheap,
+latency-bound) versus (sp−1) K/V hops (bandwidth overlapped with
+compute); Ulysses needs heads % sp == 0 and holds the FULL sequence's
+K/V per head group (activation memory is identical — S·H/sp ≡ S/sp·H —
+the asymmetry is score/working-set shape: a flash attend over full-S
+blocks here vs ring's (S/sp)-sized blocks, and ring never materializes
+full-S K/V on a chip). Rule of thumb on TPU: Ulysses when heads are
+plentiful and full-S K/V fits per chip (video frame axes, ≤~10^4
+tokens); ring when the sequence axis is the thing that doesn't fit.
+Both are exact — same math, same bytes.
+
+Use inside shard_map with the sequence axis sharded over `axis_name`:
+    out = ulysses_attention(q, k, v, axis_name="sp")
+"""
+from __future__ import annotations
+
+import jax
+
+from arbius_tpu.ops.flash import attention as _attend
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str) -> jax.Array:
+    """Exact attention over a sequence sharded on `axis_name`.
+
+    Shapes per shard: q/k/v [B, H, S_local, D] with H % sp == 0.
+    Returns [B, H, S_local, D] in q.dtype. Must run inside shard_map
+    with `axis_name` in the mesh.
+    """
+    sp = jax.lax.psum(1, axis_name)
+    h = q.shape[1]
+    if h % sp:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by the "
+                         f"sp axis size ({sp})")
+
+    def seq_to_heads(t):
+        # [B, H, S/sp, D] → [B, H/sp, S, D]: hand each rank a head group
+        # carrying the full sequence
+        return jax.lax.all_to_all(t, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def heads_to_seq(t):
+        return jax.lax.all_to_all(t, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # backend-dispatching attention (ops/flash.py): pallas flash kernel on
+    # TPU for long sequences — memory stays linear in S, which is the
+    # whole point at this strategy's operating range — XLA einsum
+    # otherwise; identical bytes either way, already q.dtype
+    out = _attend(q, k, v)
+    return heads_to_seq(out)

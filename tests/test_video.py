@@ -18,7 +18,11 @@ from arbius_tpu.models.video import (
     UNet3DConfig,
 )
 from arbius_tpu.models.sd15 import ByteTokenizer
-from arbius_tpu.ops import ring_attention, sp_attention_reference
+from arbius_tpu.ops import (
+    ring_attention,
+    sp_attention_reference,
+    ulysses_attention,
+)
 from arbius_tpu.parallel import MeshSpec, build_mesh
 
 pytestmark = [pytest.mark.slow, pytest.mark.model]
@@ -159,3 +163,39 @@ def test_video_to_mp4_path():
     m1 = encode_mp4(frames[0], fps=8)
     m2 = encode_mp4(frames[0].copy(), fps=8)
     assert m1 == m2 and m1[4:8] == b"ftyp"
+
+
+def test_ulysses_attention_matches_reference():
+    """All-to-all SP (DeepSpeed-Ulysses form) ≡ full softmax, exactly —
+    the second first-class long-context strategy beside ring."""
+    mesh = build_mesh(MeshSpec(sp=4), devices=jax.devices()[:4])
+    B, H, S, D = 2, 4, 16, 8   # H divisible by sp
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(k1, (B, H, S, D), jnp.float32)
+    k = jax.random.normal(k2, (B, H, S, D), jnp.float32)
+    v = jax.random.normal(k3, (B, H, S, D), jnp.float32)
+    uly = jax.jit(shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
+        mesh=mesh, in_specs=P(None, None, "sp", None),
+        out_specs=P(None, None, "sp", None), check_rep=False))
+    got = np.asarray(uly(q, k, v))
+    want = np.asarray(sp_attention_reference(q, k, v))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    # ulysses and ring agree with each other too
+    ring = jax.jit(shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
+        mesh=mesh, in_specs=P(None, None, "sp", None),
+        out_specs=P(None, None, "sp", None), check_rep=False))
+    np.testing.assert_allclose(got, np.asarray(ring(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = build_mesh(MeshSpec(sp=4), devices=jax.devices()[:4])
+    q = jnp.zeros((1, 3, 16, 4))  # 3 heads, sp=4
+    f = shard_map(
+        lambda q: ulysses_attention(q, q, q, axis_name="sp"),
+        mesh=mesh, in_specs=P(None, None, "sp", None),
+        out_specs=P(None, None, "sp", None), check_rep=False)
+    with pytest.raises(ValueError, match="divisible"):
+        f(q)
