@@ -74,6 +74,8 @@ EVENT_ABI = {
     "OwnershipTransferred": (
         "OwnershipTransferred(address,address)", [
             ("previous", "address", True), ("to", "address", True)]),
+    "TreasuryTransferred": ("TreasuryTransferred(address)", [
+        ("to", "address", True)]),
     "ProposalCreated": ("ProposalCreated(bytes32,address)", [
         ("id", "bytes32", True), ("proposer", "address", True)]),
 }
@@ -213,6 +215,36 @@ class DevnetNode:
                                   if eng.pauser is not None else None))),
         }
 
+        # every owner-tunable parameter setter, governable via the
+        # timelock and callable directly by the owner (EngineV1.sol:306-386)
+        self._param_views: dict = {}
+        for _setter in Engine.PARAMS:
+            _sig = f"{_setter}(uint256)"
+            self._timelock_calls[(self.engine_address,
+                                  _selector(_sig))] = (
+                ["uint256"],
+                lambda v, _s=_setter: eng.set_param(
+                    _s, v[0], sender=(self.governor_address
+                                      if eng.owner is not None else None)))
+            self._engine_writes[_selector(_sig)] = (
+                ["uint256"],
+                lambda s, v, _s=_setter: eng.set_param(_s, v[0], sender=s))
+            # matching eth_call getter (solidity public-var accessor name:
+            # setter minus the 'set' prefix, lowerCamel)
+            _getter = _setter[3].lower() + _setter[4:] + "()"
+            _attr = Engine.PARAMS[_setter]
+            self._param_views[_selector(_getter)] = (
+                [], ["uint256"],
+                lambda v, _a=_attr: [getattr(eng, _a)])
+        self._timelock_calls[(self.engine_address,
+                              _selector("transferTreasury(address)"))] = (
+            ["address"],
+            lambda v: eng.transfer_treasury(
+                v[0], sender=(self.governor_address
+                              if eng.owner is not None else None)))
+        self._engine_writes[_selector("transferTreasury(address)")] = (
+            ["address"], lambda s, v: eng.transfer_treasury(v[0], sender=s))
+
         def _gov_action(target: str, value: int, calldata: bytes):
             if value != 0:
                 raise DevnetError("devnet proposals cannot carry ETH value")
@@ -302,6 +334,7 @@ class DevnetNode:
                     if m else [0, "0x" + "00" * 20, 0, b""])
 
         self._engine_views = {
+            **self._param_views,  # solidity public-var accessors per param
             _selector("accruedFees()"): (
                 [], ["uint256"], lambda v: [eng.accrued_fees]),
             _selector("treasury()"): (

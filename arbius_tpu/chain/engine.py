@@ -617,6 +617,42 @@ class Engine:
         self._emit("OwnershipTransferred", previous=prev or ZERO,
                    to=self.owner)
 
+    # owner-tunable protocol parameters (EngineV1.sol:313-386): Solidity
+    # setter name → engine attribute
+    PARAMS = {
+        "setValidatorMinimumPercentage": "validator_minimum_percentage",
+        "setSlashAmountPercentage": "slash_amount_percentage",
+        "setSolutionFeePercentage": "solution_fee_percentage",
+        "setRetractionFeePercentage": "retraction_fee_percentage",
+        "setTreasuryRewardPercentage": "treasury_reward_percentage",
+        "setMinClaimSolutionTime": "min_claim_solution_time",
+        "setMinRetractionWaitTime": "min_retraction_wait_time",
+        "setMinContestationVotePeriodTime":
+            "min_contestation_vote_period_time",
+        "setMaxContestationValidatorStakeSince":
+            "max_contestation_validator_stake_since",
+        "setExitValidatorMinUnlockTime": "exit_validator_min_unlock_time",
+    }
+
+    def set_param(self, setter: str, value: int, *,
+                  sender: str | None = None):
+        """Owner-gated protocol-parameter setters, one per EngineV1
+        onlyOwner function (the *Changed event per setter is collapsed to
+        a generic ParamChanged — the devnet's log surface doesn't carry
+        the per-setter events either)."""
+        self._only(sender, self.owner, "owner")
+        attr = self.PARAMS.get(setter)
+        if attr is None:
+            raise EngineError(f"unknown parameter setter {setter!r}")
+        setattr(self, attr, int(value))
+        self._emit("ParamChanged", setter=setter, value=int(value))
+
+    def transfer_treasury(self, to: str, *, sender: str | None = None):
+        """EngineV1.sol:272-275."""
+        self._only(sender, self.owner, "owner")
+        self.treasury = _addr(to)
+        self._emit("TreasuryTransferred", to=self.treasury)
+
     def set_version(self, version: int, *, sender: str | None = None):
         self._only(sender, self.owner, "owner")
         self.version = version
