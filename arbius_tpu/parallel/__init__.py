@@ -29,6 +29,7 @@ from arbius_tpu.parallel.sharding import (
     replicated,
     shard_params,
     sharding_for,
+    sharding_tree,
 )
 from arbius_tpu.parallel.collectives import (
     all_gather_seq,
@@ -47,6 +48,7 @@ __all__ = [
     "replicated",
     "shard_params",
     "sharding_for",
+    "sharding_tree",
     "all_gather_seq",
     "halo_exchange",
     "ring_pass",
