@@ -5,31 +5,44 @@ DPMSolverMultistep, CFG — the anythingv3 queue's shape. Weights are
 deterministically random (init_params); FLOPs and memory traffic are
 identical to converted weights, so throughput is representative.
 
-Structure — an escalation ladder that cannot print nothing (rounds 1-2
-both timed out with zero output; the round-2 postmortem: eager 860M-param
-init dispatched op-by-op over the remote-TPU tunnel, inside a monolithic
-all-or-nothing script):
+Structure — ONE claim, one session (round-4 redesign). The axon pool
+serves ONE chip and every process pays its own claim; when the pool is
+draining a lost grant a claim can silently burn ~1500 s and exit 0 with
+no output. Rounds 1-3 spent whole bench windows on serialized claims.
+So the ladder is now a single TPU SESSION subprocess that claims once
+and runs every stage against that claim, emitting one JSON line per
+result the moment it exists:
 
-  stage tiny     tiny topology, 128×128×4 — proves the TPU executes
-                 end-to-end in ~a minute; no perf claim (vs_baseline 0).
-  stage prod     full production topology at 512×512. Emits TWO lines:
-                 first a measured-4-step run extrapolated to 20 steps
-                 (clearly labeled; conservative — fixed text/VAE overhead
-                 is counted 5×), then the real 20-step measurement.
+  tiny          tiny topology, 128×128×4 — proves the chip executes
+                end-to-end in ~a minute; no perf claim (vs_baseline 0).
+  prod4         full 860M topology at 512×512, measured 4-step,
+                extrapolated ×5 to the 20-step metric (conservative:
+                fixed text/VAE overhead is re-counted 5×).
+  prod20        the real metric — 512×512, 20 steps, measured.
+  prod20_bf16   same, bf16 weights (the production configuration).
+  sweep_bN      canonical-batch throughput curve, batch ∈ {2,4,8},
+                bf16 — the single-chip half of the dp story.
+  headline      re-emits the BEST measured solutions/hour LAST (the
+                driver records the last line as the result).
+  goldens       if time remains: record-golden vectors on this chip at
+                the production shape, written into goldens/ (the boot
+                self-test admission vectors — miner/src/index.ts:984).
 
-Each stage runs in its own time-boxed subprocess; the child appends one
-JSON object per result line to a scratch file, and the parent streams
-every completed line to stdout the moment it appears — so a driver kill
-at ANY point still leaves the best-so-far number printed. Children
-heartbeat their current phase to stderr every 15 s, so a timeout shows
-*where* it died (init? compile? execute?). Param init runs as one jitted
-on-device program (see SD15Pipeline.init_params).
+The session child streams lines to a scratch file; the parent prints
+each completed line immediately, so a driver kill at ANY point keeps
+the best-so-far number. The child keeps an internal deadline (budget
+minus margin) and SKIPS remaining stages to exit cleanly — a killed
+TPU-holding process wedges the pool's grant for hours, so clean exit is
+part of the protocol. Children heartbeat their phase to stderr every
+15 s. Param init + dtype casts each run as one jitted program (eager
+per-leaf dispatch over the remote-TPU tunnel was the round-2 failure).
 
-If the TPU tunnel probe fails, the tiny stage runs on CPU and the line is
-flagged `tpu_unreachable_cpu_fallback` with vs_baseline 0 (no perf claim).
-
-The last line printed is the final result:
-{"metric", "value", "unit", "vs_baseline", ...}.
+If the session produces zero lines (wedged pool: the claim self-expires
+silently), the parent falls back to a CPU tiny stage flagged
+`tpu_unreachable_cpu_fallback` with vs_baseline 0 (no perf claim).
+CPU children exit via os._exit after their last line: round 3 showed a
+CPU child's interpreter teardown dialing the wedged tunnel and hanging
+~1500 s after the result was already emitted.
 
 `vs_baseline` is measured against ~1800 solutions/hour for the single-A100
 cog miner the reference requires (docs/src/pages/mining.mdx:7-19). That
@@ -51,16 +64,21 @@ A100_SOLUTIONS_PER_HOUR_EST = 1800.0  # builder's estimate — see docstring
 WIDTH = HEIGHT = 512
 STEPS = 20
 SCHEDULER = "DPMSolverMultistep"
-# The axon pool's chip claim can take up to its client-side timeout
-# (~1500s observed when the pool is draining a lost grant; the client
-# then exits 0 SILENTLY — an empty result file is the only signal).
-# Every subprocess pays its own claim, so stage budgets = claim + work.
-# There is no separate probe: the tiny stage IS the probe (zero lines
-# from its TPU attempt ⇒ no TPU ⇒ guaranteed CPU-fallback line), which
-# saves one full serialized claim per run.
-TINY_TIMEOUT_S = int(os.environ.get("BENCH_TINY_TIMEOUT_S", "2100"))
+METRIC = "anythingv3_solutions_per_hour_per_chip"
+BASELINE_NOTE = ("anchor 1800 sol/h/A100 is this repo's estimate; "
+                 "reference publishes no numbers")
+
+# Session budget: one claim + every stage. A wedged pool's claim
+# self-expires at ~1500 s (silent rc=0, zero lines); a claim that hangs
+# BEYOND that is aborted at the no-line timeout so the CPU fallback
+# still lands inside a 60-min outer window (worst case ≈ 1800 s abort +
+# 600 s fallback). A healthy session that is emitting lines keeps the
+# full budget.
+SESSION_TIMEOUT_S = int(os.environ.get("BENCH_SESSION_TIMEOUT_S", "3300"))
+SESSION_NOLINE_ABORT_S = int(os.environ.get("BENCH_SESSION_NOLINE_ABORT_S",
+                                            "1800"))
+SESSION_MARGIN_S = int(os.environ.get("BENCH_SESSION_MARGIN_S", "150"))
 TINY_CPU_TIMEOUT_S = int(os.environ.get("BENCH_TINY_CPU_TIMEOUT_S", "600"))
-PROD_TIMEOUT_S = int(os.environ.get("BENCH_PROD_TIMEOUT_S", "3900"))
 
 _T0 = time.perf_counter()
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -72,12 +90,19 @@ def _note(msg: str) -> None:
 
 
 # ---------------------------------------------------------------------------
-# parent: probe, ladder, line streaming
+# parent: ladder + line streaming
 # ---------------------------------------------------------------------------
 
-def _stream_stage(stage: str, timeout_s: int, extra_env: dict | None = None) -> int:
+def _stream_stage(stage: str, timeout_s: int, extra_env: dict | None = None,
+                  noline_timeout_s: int | None = None) -> int:
     """Run a stage child; stream each completed JSON line from its scratch
-    file to stdout as it appears. Returns the number of lines emitted."""
+    file to stdout as it appears. Returns the number of lines emitted.
+
+    `noline_timeout_s`: kill the child early if it has produced ZERO
+    result lines by then — a claim that hangs past the axon client's own
+    ~1500s self-expiry is never going to produce anything, and letting it
+    run the full stage budget would push the guaranteed CPU fallback out
+    of the driver's outer window (the round-1/2 zero-output failure)."""
     out_path = os.path.join(_REPO, f".bench_{stage}.jsonl")
     try:
         os.unlink(out_path)
@@ -109,13 +134,32 @@ def _stream_stage(stage: str, timeout_s: int, extra_env: dict | None = None) -> 
             emitted += 1
         return emitted
 
+    start = time.perf_counter()
     while child.poll() is None and time.perf_counter() < deadline:
         drain()
+        if (noline_timeout_s is not None and emitted == 0
+                and time.perf_counter() - start > noline_timeout_s):
+            _note(f"stage {stage}: zero lines after {noline_timeout_s}s "
+                  "(claim hung past the client's own expiry) — killing so "
+                  "the fallback still fits the outer window")
+            break
         time.sleep(1.0)
     if child.poll() is None:
-        _note(f"stage {stage}: TIMED OUT after {timeout_s}s — killing")
-        child.kill()
-        child.wait()
+        if time.perf_counter() >= deadline:
+            _note(f"stage {stage}: TIMED OUT after {timeout_s}s")
+        # SIGTERM first and give the child a grace window: a SIGKILLed
+        # chip-holding process wedges the pool grant for hours (round-3
+        # postmortem); the term handler lets interpreter teardown release
+        # the claim cleanly. Only escalate if the grace expires.
+        child.terminate()
+        try:
+            child.wait(timeout=60)
+            _note(f"stage {stage}: exited rc={child.returncode} after "
+                  "SIGTERM (claim released cleanly)")
+        except subprocess.TimeoutExpired:
+            _note(f"stage {stage}: ignored SIGTERM for 60s — killing")
+            child.kill()
+            child.wait()
     else:
         _note(f"stage {stage}: exited rc={child.returncode}")
     drain()
@@ -132,17 +176,16 @@ def main() -> None:
         # A stale exported BENCH_FALLBACK_NOTE would silently force the
         # tiny child onto CPU despite a healthy TPU.
         os.environ.pop("BENCH_FALLBACK_NOTE", None)
-        # TPU attempt — doubles as the probe: a wedged pool's claim
-        # self-expires (~1500s, silent rc=0) and leaves zero lines
-        total += _stream_stage("tiny", TINY_TIMEOUT_S)
+        total += _stream_stage(
+            "session", SESSION_TIMEOUT_S,
+            {"BENCH_SESSION_BUDGET_S": str(SESSION_TIMEOUT_S)},
+            noline_timeout_s=SESSION_NOLINE_ABORT_S)
         if total == 0:
-            _note("tiny TPU attempt produced nothing — no TPU; "
+            _note("TPU session produced nothing — no chip; "
                   "running guaranteed CPU-fallback line")
             total += _stream_stage(
                 "tiny", TINY_CPU_TIMEOUT_S,
                 {"BENCH_FALLBACK_NOTE": "tpu_unreachable_cpu_fallback"})
-        else:
-            total += _stream_stage("prod", PROD_TIMEOUT_S)
     if total == 0:
         _emit_backstop("all_stages_failed")
     _note(f"done: {total} result line(s)")
@@ -150,7 +193,7 @@ def main() -> None:
 
 def _emit_backstop(note: str) -> None:
     print(json.dumps({
-        "metric": "anythingv3_solutions_per_hour_per_chip",
+        "metric": METRIC,
         "value": 0.0,
         "unit": f"solutions/hour/chip (BENCH STAGE FAILURE: {note} — see stderr)",
         "vs_baseline": 0.0,
@@ -192,6 +235,18 @@ def _emit(out_path: str, line: dict) -> None:
     _note(f"result: {json.dumps(line)}")
 
 
+def _arm_exit_watchdog(grace_s: float = 90.0) -> None:
+    """Force-exit if interpreter teardown hangs (observed: a child's
+    teardown dialed the wedged tunnel and sat ~1500 s after its last
+    result line). Clean teardown normally wins the race."""
+    def _fire():
+        time.sleep(grace_s)
+        _note(f"teardown exceeded {grace_s:.0f}s — forcing exit")
+        os._exit(0)
+
+    threading.Thread(target=_fire, daemon=True).start()
+
+
 def _timed_solutions(pipe, params, batch: int, *, width: int, height: int,
                      steps: int, rounds: int, hb: _Heartbeat) -> float:
     """Compile + warm up one bucket, then time `rounds` runs.
@@ -205,7 +260,8 @@ def _timed_solutions(pipe, params, batch: int, *, width: int, height: int,
     hb.set(f"compile+warmup {width}x{height} steps={steps} batch={batch}")
     out = pipe.generate(params, prompts, negs, list(range(batch)), **kw)
     assert out.shape == (batch, height, width, 3) and out.dtype == np.uint8
-    hb.set(f"timing {rounds} round(s) of {width}x{height} steps={steps}")
+    hb.set(f"timing {rounds} round(s) of {width}x{height} steps={steps} "
+           f"batch={batch}")
     t0 = time.perf_counter()
     for r in range(rounds):
         pipe.generate(params, prompts, negs,
@@ -233,17 +289,10 @@ def _child_common(cpu: bool):
 
 
 def _stage_tiny(out_path: str) -> None:
-    """Tiny topology end-to-end — a number in about a minute, no perf claim."""
+    """Tiny topology on CPU — the guaranteed-fallback line, no perf claim."""
     hb = _Heartbeat("tiny")
-    devs = _child_common(cpu=bool(os.environ.get("BENCH_FALLBACK_NOTE")))
+    devs = _child_common(cpu=True)
     platform = devs[0].platform
-    if not os.environ.get("BENCH_FALLBACK_NOTE") and platform == "cpu":
-        # TPU-attempt mode but the backend silently fell back to CPU:
-        # emit nothing so the parent takes the explicit CPU-fallback path
-        # (prod on CPU would burn the whole budget for a useless number)
-        _note("TPU attempt landed on a CPU backend — deferring to the "
-              "parent's explicit CPU fallback")
-        sys.exit(4)
 
     from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline
     from arbius_tpu.node.factory import tiny_byte_tokenizer
@@ -256,7 +305,7 @@ def _stage_tiny(out_path: str) -> None:
                            rounds=2, hb=hb)
     note = os.environ.get("BENCH_FALLBACK_NOTE", "stage_tiny_sanity")
     _emit(out_path, {
-        "metric": "anythingv3_solutions_per_hour_per_chip",
+        "metric": METRIC,
         "value": round(3600.0 / sec, 2),
         "unit": (f"solutions/hour/chip (TINY topology 128x128, 4 steps, "
                  f"platform={platform} — sanity stage, no perf claim)"),
@@ -266,93 +315,231 @@ def _stage_tiny(out_path: str) -> None:
         "elapsed_s": round(time.perf_counter() - _T0, 1),
     })
     hb.stop()
+    # teardown on a wedged tunnel can hang ~1500 s (round-3 postmortem);
+    # nothing left to do, so skip interpreter teardown entirely.
+    os._exit(0)
 
 
-def _stage_prod(out_path: str) -> None:
-    """Full production topology at 512×512: extrapolated line, then real."""
-    hb = _Heartbeat("prod")
-    _child_common(cpu=False)
+def _prod_line(val: float, unit: str, note: str, stage: str,
+               extra: dict | None = None) -> dict:
+    line = {
+        "metric": METRIC,
+        "value": round(val, 2),
+        "unit": unit,
+        "vs_baseline": round(val / A100_SOLUTIONS_PER_HOUR_EST, 3),
+        "baseline_note": BASELINE_NOTE,
+        "note": note,
+        "stage": stage,
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+    }
+    if extra:
+        line.update(extra)
+    return line
 
-    from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
 
-    pipe = SD15Pipeline(SD15Config(), tokenizer=ByteTokenizer())
-    hb.set("init_params (full 860M-class, jitted on-device)")
-    t_init = time.perf_counter()
-    params = pipe.init_params(seed=0, height=HEIGHT, width=WIDTH)
+def _stage_session(out_path: str) -> None:
+    """The whole TPU ladder against ONE chip claim (see module docstring)."""
+    import signal
+
+    # the parent's backstop is SIGTERM-then-grace; convert it to a normal
+    # exit so interpreter teardown releases the chip claim (the OS default
+    # disposition would terminate without cleanup — same wedge as SIGKILL)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    budget = int(os.environ.get("BENCH_SESSION_BUDGET_S", str(SESSION_TIMEOUT_S)))
+    deadline = _T0 + budget - SESSION_MARGIN_S
+
+    def left() -> float:
+        return deadline - time.perf_counter()
+
+    hb = _Heartbeat("session")
+    hb.set(f"claiming chip (budget {budget}s, margin {SESSION_MARGIN_S}s)")
+    devs = _child_common(cpu=False)
+    platform = devs[0].platform
+    if platform == "cpu":
+        # TPU-attempt mode but the backend silently fell back to CPU:
+        # emit nothing so the parent takes the explicit CPU-fallback path
+        _note("TPU attempt landed on a CPU backend — deferring to the "
+              "parent's explicit CPU fallback")
+        os._exit(4)
+
     import jax
 
-    jax.block_until_ready(params)
-    _note(f"init_params done in {time.perf_counter() - t_init:.1f}s")
-
-    # line 1: measured 4-step, extrapolated to the 20-step metric shape.
-    # Conservative: scaling t4 by 20/4 re-counts the fixed text-encoder +
-    # VAE + dispatch overhead 5x, so the true 20-step throughput is higher.
-    sec4 = _timed_solutions(pipe, params, 1, width=WIDTH, height=HEIGHT,
-                            steps=4, rounds=2, hb=hb)
-    est = 3600.0 / (sec4 * (STEPS / 4))
-    _emit(out_path, {
-        "metric": "anythingv3_solutions_per_hour_per_chip",
-        "value": round(est, 2),
-        "unit": (f"solutions/hour/chip (SD-1.5 512x512 FULL topology, "
-                 f"EXTRAPOLATED 20-step from measured 4-step x5, {SCHEDULER})"),
-        "vs_baseline": round(est / A100_SOLUTIONS_PER_HOUR_EST, 3),
-        "baseline_note": "anchor 1800 sol/h/A100 is this repo's estimate; "
-                         "reference publishes no numbers",
-        "note": "stage_prod_extrapolated",
-        "stage": "prod4",
-        "elapsed_s": round(time.perf_counter() - _T0, 1),
-    })
-
-    # line 2: the real metric — 20 steps measured.
-    sec20 = _timed_solutions(pipe, params, 1, width=WIDTH, height=HEIGHT,
-                             steps=STEPS, rounds=2, hb=hb)
-    val = 3600.0 / sec20
-    _emit(out_path, {
-        "metric": "anythingv3_solutions_per_hour_per_chip",
-        "value": round(val, 2),
-        "unit": (f"solutions/hour/chip (SD-1.5 512x512, {STEPS} steps, "
-                 f"{SCHEDULER}, CFG — measured on real TPU)"),
-        "vs_baseline": round(val / A100_SOLUTIONS_PER_HOUR_EST, 3),
-        "baseline_note": "anchor 1800 sol/h/A100 is this repo's estimate; "
-                         "reference publishes no numbers",
-        "note": "stage_prod_measured",
-        "stage": "prod20",
-        "elapsed_s": round(time.perf_counter() - _T0, 1),
-    })
-
-    # line 3: bf16 weights (ModelConfig.weights_dtype="bfloat16") — the
-    # production configuration, same trade as the reference's fp16 cog
-    # containers. Batch-1 diffusion is weight-bandwidth-bound, so halving
-    # weight bytes is the single biggest single-chip lever. Printed LAST:
-    # if it completes it is the headline number.
+    from arbius_tpu.models.sd15 import ByteTokenizer, SD15Config, SD15Pipeline
+    from arbius_tpu.node.factory import tiny_byte_tokenizer
     from arbius_tpu.utils import cast_floating
 
-    hb.set("casting weights to bf16")
-    # one jitted program: eager per-leaf casts would dispatch ~700 ops
-    # over the remote-TPU transport (the round-2 failure mode)
-    params16 = jax.jit(lambda p: cast_floating(p, "bfloat16"))(params)
-    jax.block_until_ready(params16)
-    sec16 = _timed_solutions(pipe, params16, 1, width=WIDTH, height=HEIGHT,
-                             steps=STEPS, rounds=2, hb=hb)
-    val16 = 3600.0 / sec16
-    _emit(out_path, {
-        "metric": "anythingv3_solutions_per_hour_per_chip",
-        "value": round(val16, 2),
-        "unit": (f"solutions/hour/chip (SD-1.5 512x512, {STEPS} steps, "
-                 f"{SCHEDULER}, CFG, bf16 weights — measured on real TPU)"),
-        "vs_baseline": round(val16 / A100_SOLUTIONS_PER_HOUR_EST, 3),
-        "baseline_note": "anchor 1800 sol/h/A100 is this repo's estimate; "
-                         "reference publishes no numbers",
-        "note": "stage_prod_measured_bf16_weights",
-        "stage": "prod20_bf16",
+    best: tuple[float, str, str] | None = None  # (value, unit, stage)
+    sweep: dict[str, float] = {}
+
+    def track(line: dict) -> None:
+        nonlocal best
+        _emit(out_path, line)
+        if line.get("vs_baseline", 0) > 0 and (
+                best is None or line["value"] > best[0]):
+            best = (line["value"], line["unit"], line["stage"])
+
+    def _headline_note(stage: str) -> str:
+        # prod4 is an EXTRAPOLATION — never let the final line claim a
+        # measurement it didn't make just because the session ran out of
+        # time before the 20-step stages
+        kind = "extrapolated" if stage == "prod4" else "measured"
+        return f"best_{kind} (from stage {stage})"
+
+    # -- tiny sanity: the chip executes end-to-end, fast ------------------
+    cfg = SD15Config.tiny()
+    tpipe = SD15Pipeline(cfg, tokenizer=tiny_byte_tokenizer(cfg.text))
+    hb.set("init_params (tiny)")
+    tparams = tpipe.init_params(seed=0, height=128, width=128)
+    sec = _timed_solutions(tpipe, tparams, 1, width=128, height=128,
+                           steps=4, rounds=2, hb=hb)
+    track({
+        "metric": METRIC,
+        "value": round(3600.0 / sec, 2),
+        "unit": (f"solutions/hour/chip (TINY topology 128x128, 4 steps, "
+                 f"platform={platform} — sanity stage, no perf claim)"),
+        "vs_baseline": 0.0,
+        "note": "stage_tiny_sanity",
+        "stage": "tiny",
         "elapsed_s": round(time.perf_counter() - _T0, 1),
     })
+
+    pipe = SD15Pipeline(SD15Config(), tokenizer=ByteTokenizer())
+    params = params16 = None
+    if left() > 240:
+        hb.set("init_params (full 860M-class, jitted on-device)")
+        t_init = time.perf_counter()
+        params = pipe.init_params(seed=0, height=HEIGHT, width=WIDTH)
+        jax.block_until_ready(params)
+        _note(f"init_params done in {time.perf_counter() - t_init:.1f}s")
+
+        # measured 4-step, extrapolated to the 20-step metric shape.
+        sec4 = _timed_solutions(pipe, params, 1, width=WIDTH, height=HEIGHT,
+                                steps=4, rounds=2, hb=hb)
+        est = 3600.0 / (sec4 * (STEPS / 4))
+        track(_prod_line(
+            est,
+            f"solutions/hour/chip (SD-1.5 512x512 FULL topology, "
+            f"EXTRAPOLATED 20-step from measured 4-step x5, {SCHEDULER})",
+            "stage_prod_extrapolated", "prod4"))
+    else:
+        _note(f"skipping prod stages: only {left():.0f}s left")
+
+    if params is not None and left() > 180:
+        # the real metric — 20 steps measured.
+        sec20 = _timed_solutions(pipe, params, 1, width=WIDTH, height=HEIGHT,
+                                 steps=STEPS, rounds=2, hb=hb)
+        track(_prod_line(
+            3600.0 / sec20,
+            f"solutions/hour/chip (SD-1.5 512x512, {STEPS} steps, "
+            f"{SCHEDULER}, CFG — measured on real TPU)",
+            "stage_prod_measured", "prod20"))
+
+    if params is not None and left() > 180:
+        # bf16 weights (ModelConfig.weights_dtype="bfloat16") — the
+        # production configuration, same trade as the reference's fp16 cog
+        # containers. Batch-1 diffusion is weight-bandwidth-bound, so
+        # halving weight bytes is the single biggest single-chip lever.
+        hb.set("casting weights to bf16 (one jitted program)")
+        params16 = jax.jit(lambda p: cast_floating(p, "bfloat16"))(params)
+        jax.block_until_ready(params16)
+        sec16 = _timed_solutions(pipe, params16, 1, width=WIDTH,
+                                 height=HEIGHT, steps=STEPS, rounds=2, hb=hb)
+        track(_prod_line(
+            3600.0 / sec16,
+            f"solutions/hour/chip (SD-1.5 512x512, {STEPS} steps, "
+            f"{SCHEDULER}, CFG, bf16 weights — measured on real TPU)",
+            "stage_prod_measured_bf16_weights", "prod20_bf16"))
+
+    # -- canonical-batch throughput curve (single-chip dp story) ----------
+    if params16 is not None:
+        for b in (2, 4, 8):
+            if left() < 240:
+                _note(f"skipping sweep b={b}: only {left():.0f}s left")
+                break
+            secb = _timed_solutions(pipe, params16, b, width=WIDTH,
+                                    height=HEIGHT, steps=STEPS, rounds=1,
+                                    hb=hb)
+            vb = 3600.0 / secb
+            sweep[str(b)] = round(vb, 2)
+            track(_prod_line(
+                vb,
+                f"solutions/hour/chip (SD-1.5 512x512, {STEPS} steps, "
+                f"{SCHEDULER}, CFG, bf16, canonical_batch={b} — measured "
+                f"on real TPU)",
+                "stage_batch_sweep", f"sweep_b{b}"))
+
+    # -- headline: best number LAST among result lines (driver records the
+    # last line) — emitted BEFORE the goldens stage on purpose: goldens
+    # emit no result lines, and an overrun there must not cost the labeled
+    # best number
+    if best is not None:
+        track(_prod_line(
+            best[0], best[1], _headline_note(best[2]), "headline",
+            {"batch_sweep": sweep} if sweep else None))
+
+    # -- goldens: admission vectors on this chip, while we hold it --------
+    if left() > 420 and os.environ.get("BENCH_RECORD_GOLDENS", "1") != "0":
+        try:
+            _record_goldens(hb, left)
+        except Exception as e:  # goldens are a bonus — never fail the bench
+            _note(f"golden recording failed: {type(e).__name__}: {e}")
     hb.stop()
+    _note("session complete; releasing claim via clean exit")
+    _arm_exit_watchdog(90.0)
+
+
+def _record_goldens(hb: _Heartbeat, left) -> None:
+    """Record boot-self-test golden CIDs on the claimed chip at template
+    default (production) shapes, written straight into goldens/. The
+    repo's analogue of the reference's pinned admission CID
+    (miner/src/index.ts:984-1001)."""
+    import jax
+
+    from arbius_tpu.node.config import MiningConfig, ModelConfig
+    from arbius_tpu.node.factory import build_registry
+    from arbius_tpu.node.solver import solve_cid
+    from arbius_tpu.templates.engine import hydrate_input
+
+    platform = jax.devices()[0].platform
+    # anythingv3 goldens pin the METRIC shape (512×512×20 — same programs
+    # the bench stages just compiled, so the executable cache is warm);
+    # kandinsky2 pins its template-default 768².
+    metric_shape = {"negative_prompt": "", "width": WIDTH, "height": HEIGHT,
+                    "num_inference_steps": STEPS, "scheduler": SCHEDULER}
+    jobs = [
+        # (template, dtype, input-overrides, min seconds left to attempt)
+        ("anythingv3", "bfloat16", metric_shape, 420),
+        ("anythingv3", "float32", metric_shape, 360),
+        ("kandinsky2", "bfloat16", {}, 900),
+    ]
+    for template, dtype, overrides, need in jobs:
+        if left() < need:
+            _note(f"golden {template}/{dtype}: skipped ({left():.0f}s left)")
+            continue
+        hb.set(f"golden {template} {dtype}")
+        raw = {"prompt": "arbius test cat", **overrides}
+        mc = ModelConfig(id="0x" + "00" * 32, template=template,
+                         weights_dtype=dtype)
+        m = build_registry(MiningConfig(models=(mc,))).get(mc.id)
+        hydrated = hydrate_input(dict(raw), m.template)
+        t0 = time.perf_counter()
+        cid, _files = solve_cid(m, hydrated, 1337)
+        rec = {
+            "template": template, "platform": platform, "tiny": False,
+            "weights_dtype": dtype,
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+            "golden": {"input": raw, "seed": 1337, "cid": cid},
+        }
+        path = os.path.join(_REPO, "goldens",
+                            f"{template}.full.{platform}.{dtype}.json")
+        with open(path, "w") as f:
+            json.dump(rec, f)
+        _note(f"golden recorded: {path} cid={cid}")
 
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--stage", choices=["tiny", "prod"])
+    ap.add_argument("--stage", choices=["tiny", "session"])
     ap.add_argument("--out")
     ns = ap.parse_args()
     if ns.stage is not None and not ns.out:
@@ -362,4 +549,4 @@ if __name__ == "__main__":
     elif ns.stage == "tiny":
         _stage_tiny(ns.out)
     else:
-        _stage_prod(ns.out)
+        _stage_session(ns.out)
