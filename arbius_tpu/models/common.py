@@ -82,6 +82,9 @@ class ResnetBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     scale_shift: bool = False
     resample: str = "none"
+    # published norm eps differs per family: diffusers UNets use 1e-5,
+    # AutoencoderKL/VQ VAEs use 1e-6 — part of checkpoint fidelity
+    norm_eps: float = 1e-5
 
     def _resample(self, x):
         if self.resample == "down":
@@ -93,7 +96,7 @@ class ResnetBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, temb=None):
-        h = GroupNorm32()(x)
+        h = GroupNorm32(epsilon=self.norm_eps)(x)
         h = nn.silu(h)
         if self.resample != "none":
             h = self._resample(h)
@@ -105,7 +108,7 @@ class ResnetBlock(nn.Module):
             t = nn.Dense(width, dtype=self.dtype)(nn.silu(temb))
             if not self.scale_shift:
                 h = h + t[:, None, None, :]
-        h = GroupNorm32()(h)
+        h = GroupNorm32(epsilon=self.norm_eps)(h)
         if t is not None and self.scale_shift:
             scale, shift = jnp.split(t[:, None, None, :], 2, axis=-1)
             h = h * (1 + scale) + shift
@@ -188,10 +191,10 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, context=None):
         x = x + Attention(self.num_heads, self.head_dim, self.dtype, name="attn1")(
-            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype))
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype))
         x = x + Attention(self.num_heads, self.head_dim, self.dtype, name="attn2")(
-            nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype), context=context)
-        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+            nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype), context=context)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(self.dtype)
         h = GEGLU(x.shape[-1] * 4, self.dtype, name="ff")(h)
         h = nn.Dense(x.shape[-1], dtype=self.dtype, name="ff_out")(h)
         return x + h
@@ -208,7 +211,9 @@ class SpatialTransformer(nn.Module):
     def __call__(self, x, context=None):
         b, h, w, c = x.shape
         residual = x
-        x = GroupNorm32()(x)
+        # diffusers Transformer2DModel pins its pre-proj_in GroupNorm to
+        # eps=1e-6 (unlike the 1e-5 resnet norms) — checkpoint fidelity
+        x = GroupNorm32(epsilon=1e-6)(x)
         x = nn.Conv(c, (1, 1), dtype=self.dtype, name="proj_in")(x)
         x = x.reshape(b, h * w, c)
         for i in range(self.depth):

@@ -186,7 +186,7 @@ class DecoderUNet(nn.Module):
                        name="embed_to_context")(emb)
         ctx = ctx.reshape(emb.shape[0], cfg.context_tokens,
                           cfg.unet.context_dim)
-        ctx = nn.LayerNorm(dtype=jnp.float32, name="context_norm")(
+        ctx = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="context_norm")(
             ctx.astype(jnp.float32)).astype(dt)
         # additive timestep-embedding branch (published add_embedding)
         tdim = cfg.unet.block_channels[0] * 4

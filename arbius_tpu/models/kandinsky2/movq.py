@@ -54,7 +54,7 @@ class SpatialNorm(nn.Module):
     def __call__(self, h, z):
         b, hh, ww, c = h.shape
         z_up = jax.image.resize(z, (b, hh, ww, z.shape[-1]), method="nearest")
-        normed = GroupNorm32(name="norm")(h)
+        normed = GroupNorm32(epsilon=1e-6, name="norm")(h)
         scale = nn.Conv(c, (1, 1), dtype=self.dtype, name="conv_y")(z_up)
         shift = nn.Conv(c, (1, 1), dtype=self.dtype, name="conv_b")(z_up)
         return normed * scale.astype(normed.dtype) + shift.astype(normed.dtype)

@@ -73,10 +73,10 @@ class PriorBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None):
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm1")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm1")(x).astype(self.dtype)
         x = x + Attention(self.heads, self.head_dim, self.dtype,
                           qkv_bias=True, name="attn1")(h, mask=mask)
-        h = nn.LayerNorm(dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm3")(x).astype(self.dtype)
         h = nn.Dense(x.shape[-1] * 4, dtype=self.dtype, name="ff_in")(h)
         h = nn.gelu(h, approximate=False)  # diffusers 'gelu' = exact erf
         h = nn.Dense(x.shape[-1], dtype=self.dtype, name="ff_out")(h)
@@ -133,7 +133,7 @@ class PriorTransformer(nn.Module):
         for i in range(cfg.layers):
             seq = PriorBlock(cfg.heads, W // cfg.heads, dt,
                              name=f"block_{i}")(seq, mask=mask)
-        out = nn.LayerNorm(dtype=jnp.float32, name="norm_out")(
+        out = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="norm_out")(
             seq[:, -1].astype(jnp.float32))
         return nn.Dense(cfg.clip_dim, dtype=jnp.float32, name="out_proj")(out)
 

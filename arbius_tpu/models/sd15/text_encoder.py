@@ -41,11 +41,11 @@ class _EncoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, mask):
         dt = self.cfg.jdtype
-        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(dt)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(dt)
         h = nn.SelfAttention(num_heads=self.cfg.heads, dtype=dt,
                              name="attn")(h, mask=mask)
         x = x + h
-        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(dt)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)(x).astype(dt)
         h = nn.Dense(self.cfg.width * 4, dtype=dt)(h)
         # "gelu" towers (OpenCLIP ViT-H/bigG) use torch nn.GELU's EXACT
         # erf form; jax.nn.gelu defaults to the tanh approximation, which
@@ -71,4 +71,4 @@ class TextEncoder(nn.Module):
         causal = nn.make_causal_mask(ids)
         for i in range(cfg.layers):
             x = _EncoderLayer(cfg, name=f"layer_{i}")(x, causal)
-        return nn.LayerNorm(dtype=jnp.float32, name="final_norm")(x)
+        return nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name="final_norm")(x)

@@ -47,7 +47,7 @@ class _MidAttention(nn.Module):
     def __call__(self, x):
         b, h, w, c = x.shape
         residual = x
-        x = GroupNorm32()(x)
+        x = GroupNorm32(epsilon=1e-6)(x)
         x = x.reshape(b, h * w, c)
         # qkv_bias=True: the published VAE checkpoints carry q/k/v biases
         x = Attention(num_heads=1, head_dim=c, dtype=self.dtype,
@@ -66,16 +66,16 @@ class VAEDecoder(nn.Module):
         z = nn.Conv(cfg.latent_channels, (1, 1), dtype=dt, name="post_quant")(z)
         h = nn.Conv(cfg.block_channels[-1], (3, 3), padding=1, dtype=dt,
                     name="conv_in")(z)
-        h = ResnetBlock(cfg.block_channels[-1], dt, name="mid_res_0")(h)
+        h = ResnetBlock(cfg.block_channels[-1], dt, norm_eps=1e-6, name="mid_res_0")(h)
         h = _MidAttention(dt, name="mid_attn")(h)
-        h = ResnetBlock(cfg.block_channels[-1], dt, name="mid_res_1")(h)
+        h = ResnetBlock(cfg.block_channels[-1], dt, norm_eps=1e-6, name="mid_res_1")(h)
         for level in reversed(range(len(cfg.block_channels))):
             ch = cfg.block_channels[level]
             for j in range(cfg.layers_per_block + 1):
-                h = ResnetBlock(ch, dt, name=f"up_{level}_res_{j}")(h)
+                h = ResnetBlock(ch, dt, norm_eps=1e-6, name=f"up_{level}_res_{j}")(h)
             if level > 0:
                 h = Upsample(ch, dt, name=f"up_{level}_us")(h)
-        h = GroupNorm32(name="norm_out")(h)
+        h = GroupNorm32(epsilon=1e-6, name="norm_out")(h)
         h = nn.silu(h)
         # final conv in fp32: pixel values feed the deterministic PNG path
         return nn.Conv(3, (3, 3), padding=1, dtype=jnp.float32,
@@ -93,13 +93,13 @@ class VAEEncoder(nn.Module):
                     name="conv_in")(x.astype(dt))
         for level, ch in enumerate(cfg.block_channels):
             for j in range(cfg.layers_per_block):
-                h = ResnetBlock(ch, dt, name=f"down_{level}_res_{j}")(h)
+                h = ResnetBlock(ch, dt, norm_eps=1e-6, name=f"down_{level}_res_{j}")(h)
             if level < len(cfg.block_channels) - 1:
                 h = Downsample(ch, dt, name=f"down_{level}_ds")(h)
-        h = ResnetBlock(cfg.block_channels[-1], dt, name="mid_res_0")(h)
+        h = ResnetBlock(cfg.block_channels[-1], dt, norm_eps=1e-6, name="mid_res_0")(h)
         h = _MidAttention(dt, name="mid_attn")(h)
-        h = ResnetBlock(cfg.block_channels[-1], dt, name="mid_res_1")(h)
-        h = GroupNorm32(name="norm_out")(h)
+        h = ResnetBlock(cfg.block_channels[-1], dt, norm_eps=1e-6, name="mid_res_1")(h)
+        h = GroupNorm32(epsilon=1e-6, name="norm_out")(h)
         h = nn.silu(h)
         # mean + logvar
         return nn.Conv(cfg.latent_channels * 2, (3, 3), padding=1,

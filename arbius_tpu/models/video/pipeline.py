@@ -48,8 +48,10 @@ class Text2VideoConfig:
                                                 layers=24, act="gelu")
 
     @classmethod
-    def tiny(cls, sp_axis: str | None = None) -> "Text2VideoConfig":
-        return cls(unet=UNet3DConfig.tiny(sp_axis=sp_axis),
+    def tiny(cls, sp_axis: str | None = None,
+             sp_strategy: str = "ring") -> "Text2VideoConfig":
+        return cls(unet=UNet3DConfig.tiny(sp_axis=sp_axis,
+                                          sp_strategy=sp_strategy),
                    vae=VAEConfig.tiny(),
                    text=TextEncoderConfig.tiny())
 

@@ -47,7 +47,10 @@ from arbius_tpu.models.common import (
     sinusoidal_embedding,
 )
 from arbius_tpu.ops.ring import ring_attention, sp_attention_reference
+from arbius_tpu.ops.ulysses import ulysses_attention
 from arbius_tpu.parallel import halo_exchange
+
+SP_STRATEGIES = ("ring", "ulysses")
 
 
 @dataclass(frozen=True)
@@ -62,16 +65,31 @@ class UNet3DConfig:
     context_dim: int = 1024
     transformer_depth: int = 1
     sp_axis: str | None = None    # mesh axis frames are sharded over
+    # how sharded temporal attention communicates (SURVEY §2.6 long-
+    # context growth path): "ring" rotates K/V shards (never materializes
+    # full-T K/V; bandwidth overlapped with compute), "ulysses" re-shards
+    # frames→heads with two all_to_alls and attends over full T locally
+    # (needs heads % sp == 0 at every level — head counts here are
+    # ch // head_dim, so sp must divide min(block_channels)//head_dim and
+    # tin_heads). Both are exact; see ops/ring.py vs ops/ulysses.py.
+    sp_strategy: str = "ring"
     dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.sp_strategy not in SP_STRATEGIES:
+            raise ValueError(
+                f"sp_strategy {self.sp_strategy!r} not in {SP_STRATEGIES}")
 
     @property
     def jdtype(self):
         return jnp.dtype(self.dtype)
 
     @classmethod
-    def tiny(cls, sp_axis: str | None = None) -> "UNet3DConfig":
+    def tiny(cls, sp_axis: str | None = None,
+             sp_strategy: str = "ring") -> "UNet3DConfig":
         return cls(block_channels=(8, 8, 8, 8), layers_per_block=1,
-                   head_dim=4, tin_heads=2, context_dim=16, sp_axis=sp_axis)
+                   head_dim=4, tin_heads=2, context_dim=16, sp_axis=sp_axis,
+                   sp_strategy=sp_strategy)
 
 
 class TemporalConvLayer(nn.Module):
@@ -109,12 +127,14 @@ class TemporalConvLayer(nn.Module):
 class TemporalSelfAttention(nn.Module):
     """Self-attention over the frame axis ([N, T, C] tokens = frames).
 
-    With sp_axis: exact ring attention over the sharded frame axis —
-    online-softmax passes of K/V around the ring (ops/ring.py)."""
+    With sp_axis: exact sharded attention over the frame axis, by the
+    config's strategy — ring (online-softmax K/V passes, ops/ring.py) or
+    ulysses (all_to_all frames→heads re-shard, ops/ulysses.py)."""
     num_heads: int
     head_dim: int
     sp_axis: str | None = None
     dtype: jnp.dtype = jnp.bfloat16
+    sp_strategy: str = "ring"
 
     @nn.compact
     def __call__(self, x):
@@ -129,7 +149,9 @@ class TemporalSelfAttention(nn.Module):
                              self.head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if self.sp_axis is not None:
+        if self.sp_axis is not None and self.sp_strategy == "ulysses":
+            out = ulysses_attention(q, k, v, axis_name=self.sp_axis)
+        elif self.sp_axis is not None:
             out = ring_attention(q, k, v, axis_name=self.sp_axis)
         else:
             out = sp_attention_reference(q, k, v)
@@ -145,19 +167,22 @@ class TemporalTransformerBlock(nn.Module):
     head_dim: int
     sp_axis: str | None = None
     dtype: jnp.dtype = jnp.bfloat16
+    sp_strategy: str = "ring"
 
     @nn.compact
     def __call__(self, x):
         f32 = jnp.float32
         x = x + TemporalSelfAttention(
             self.num_heads, self.head_dim, self.sp_axis, self.dtype,
-            name="attn1")(nn.LayerNorm(dtype=f32, name="norm1")(x)
+            sp_strategy=self.sp_strategy,
+            name="attn1")(nn.LayerNorm(epsilon=1e-5, dtype=f32, name="norm1")(x)
                           .astype(self.dtype))
         x = x + TemporalSelfAttention(
             self.num_heads, self.head_dim, self.sp_axis, self.dtype,
-            name="attn2")(nn.LayerNorm(dtype=f32, name="norm2")(x)
+            sp_strategy=self.sp_strategy,
+            name="attn2")(nn.LayerNorm(epsilon=1e-5, dtype=f32, name="norm2")(x)
                           .astype(self.dtype))
-        h = nn.LayerNorm(dtype=f32, name="norm3")(x).astype(self.dtype)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=f32, name="norm3")(x).astype(self.dtype)
         h = GEGLU(x.shape[-1] * 4, self.dtype, name="ff")(h)
         h = nn.Dense(x.shape[-1], dtype=self.dtype, name="ff_out")(h)
         return x + h
@@ -173,11 +198,13 @@ class TemporalTransformer(nn.Module):
     depth: int = 1
     sp_axis: str | None = None
     dtype: jnp.dtype = jnp.bfloat16
+    sp_strategy: str = "ring"
 
     @nn.compact
     def __call__(self, x):  # [B, T, H, W, C]
         b, t, hh, ww, c = x.shape
-        h = GroupNorm32(name="norm")(x).astype(self.dtype)
+        # TransformerTemporalModel pins this GroupNorm to eps=1e-6
+        h = GroupNorm32(epsilon=1e-6, name="norm")(x).astype(self.dtype)
         # tokens: frames; batch: every spatial site → [B*H*W, T, C]
         h = h.transpose(0, 2, 3, 1, 4).reshape(b * hh * ww, t, c)
         h = nn.Dense(self.num_heads * self.head_dim, dtype=self.dtype,
@@ -185,6 +212,7 @@ class TemporalTransformer(nn.Module):
         for i in range(self.depth):
             h = TemporalTransformerBlock(
                 self.num_heads, self.head_dim, self.sp_axis, self.dtype,
+                sp_strategy=self.sp_strategy,
                 name=f"block_{i}")(h)
         # zero-init: temporal branch is identity at init (inflation check)
         h = nn.Dense(c, dtype=self.dtype, kernel_init=nn.initializers.zeros,
@@ -231,7 +259,8 @@ class UNet3DCondition(nn.Module):
         def tattn(ch, name):
             return TemporalTransformer(ch // cfg.head_dim, cfg.head_dim,
                                        cfg.transformer_depth, cfg.sp_axis,
-                                       dt, name=name)
+                                       dt, sp_strategy=cfg.sp_strategy,
+                                       name=name)
 
         h = self._spatial(
             lambda z: nn.Conv(cfg.block_channels[0], (3, 3), padding=1,
@@ -239,6 +268,7 @@ class UNet3DCondition(nn.Module):
         # published: temporal transformer on the stem, fixed head count
         h = TemporalTransformer(cfg.tin_heads, cfg.head_dim,
                                 cfg.transformer_depth, cfg.sp_axis, dt,
+                                sp_strategy=cfg.sp_strategy,
                                 name="transformer_in")(h)
         skips = [h]
         for level, ch in enumerate(cfg.block_channels):
