@@ -39,11 +39,18 @@ class ModelConfig:
     # "cid": "0x1220..."} — the TPU fleet's analogue of the reference's
     # pinned kandinsky CID (miner/src/index.ts:989-999)
     golden: dict | None = None
+    # sequence-parallel comm strategy for video templates on an sp>1
+    # mesh: "ring" (K/V rotation) or "ulysses" (all_to_all head
+    # re-shard; needs heads % sp == 0). Ignored by image templates.
+    sp_strategy: str = "ring"
 
     def __post_init__(self):
         if self.weights_dtype not in ("float32", "bfloat16"):
             raise ConfigError(f"model {self.id}: unknown weights_dtype "
                               f"{self.weights_dtype!r}")
+        if self.sp_strategy not in ("ring", "ulysses"):
+            raise ConfigError(f"model {self.id}: unknown sp_strategy "
+                              f"{self.sp_strategy!r}")
         if self.tokenizer not in ("byte", "clip_bpe"):
             raise ConfigError(f"model {self.id}: unknown tokenizer "
                               f"{self.tokenizer!r}")
@@ -121,6 +128,25 @@ class MiningConfig:
     store_dir: str | None = None     # content store root (None: don't pin)
     rpc_port: int | None = None      # control RPC + explorer + /ipfs gateway
     ipfs: IpfsConfig = IpfsConfig()  # pinning strategy
+    # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
+    # disabled): stake reads and deposits target this address instead of
+    # the node's wallet — validatorDeposit(validator, amount) is already
+    # anyone-may-top-up on-chain (EngineV1.sol:581-604). CAVEAT (boot
+    # warns): submitSolution is still gated on msg.sender's OWN stake
+    # (EngineV1.sol:398-404), so the signing wallet must also be staked
+    # to mine; full delegated SOLVING needs the reference's never-shipped
+    # solver contract. This field redirects stake management only,
+    # exactly as the commented reference code does.
+    delegated_validator: str | None = None
+
+    def __post_init__(self):
+        import re as _re
+
+        if self.delegated_validator is not None and not _re.fullmatch(
+                r"0x[0-9a-fA-F]{40}", self.delegated_validator):
+            raise ConfigError(
+                f"delegated_validator {self.delegated_validator!r} is not "
+                "a 0x address")
 
 
 @dataclass(frozen=True)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import logging
 
-from arbius_tpu.node.config import MiningConfig, ModelConfig
+from arbius_tpu.node.config import ConfigError, MiningConfig, ModelConfig
 from arbius_tpu.node.solver import (
     Kandinsky2Runner,
     ModelRegistry,
@@ -88,9 +88,35 @@ def _kandinsky2(m: ModelConfig, mesh):
 
 
 def _video(m: ModelConfig, mesh):
-    from arbius_tpu.models.video import Text2VideoConfig, Text2VideoPipeline
+    from arbius_tpu.models.video import (
+        Text2VideoConfig,
+        Text2VideoPipeline,
+        UNet3DConfig,
+    )
 
-    cfg = Text2VideoConfig.tiny() if m.tiny else Text2VideoConfig()
+    # build sharding-aware when the mesh shards frames; the model config
+    # picks HOW the sharded temporal attention communicates (ring K/V
+    # rotation vs ulysses all_to_all — SURVEY §2.6 long-context path)
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    sp_axis = "sp" if sp > 1 else None
+    if m.tiny:
+        cfg = Text2VideoConfig.tiny(sp_axis=sp_axis, sp_strategy=m.sp_strategy)
+    else:
+        cfg = Text2VideoConfig(unet=UNet3DConfig(sp_axis=sp_axis,
+                                                 sp_strategy=m.sp_strategy))
+    if sp > 1 and m.sp_strategy == "ulysses":
+        # fail at BOOT, not at first-task trace time: ulysses re-shards
+        # frames onto heads, so sp must divide every temporal head count
+        # (per-level ch // head_dim, plus the transformer_in stem)
+        u = cfg.unet
+        heads = {ch // u.head_dim for ch in u.block_channels} | {u.tin_heads}
+        bad = sorted(h for h in heads if h % sp)
+        if bad:
+            raise ConfigError(
+                f"model {m.id}: sp_strategy='ulysses' needs every temporal "
+                f"head count divisible by sp={sp}, but this topology has "
+                f"head counts {bad} — use sp_strategy='ring' (works for "
+                "any head count) or a different sp width")
     pipe = Text2VideoPipeline(cfg, tokenizer=_tokenizer_for(m, cfg.text),
                               mesh=mesh)
     return Text2VideoRunner(pipe, _params_for(pipe, m))

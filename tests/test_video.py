@@ -125,6 +125,47 @@ def test_pipeline_sp2_matches_sp1():
     assert (diff > 0).mean() < 0.02
 
 
+def test_pipeline_sp_strategy_ulysses_matches_sp1():
+    """sp_strategy="ulysses" through the PRODUCTION pipeline call-site:
+    all-to-all SP must produce the same video as the unsharded reference
+    (tiny topology: 2 heads per level, sp=2 divides them)."""
+    kw = dict(num_frames=4, width=64, height=64, num_inference_steps=2,
+              scheduler="DDIM")
+    ref_pipe = Text2VideoPipeline(Text2VideoConfig.tiny(), tokenizer=tok())
+    params = ref_pipe.init_params(seed=0)
+    ref = ref_pipe.generate(params, ["orbit"], None, [3], **kw)
+
+    mesh = build_mesh(MeshSpec(sp=2), devices=jax.devices()[:2])
+    uly_pipe = Text2VideoPipeline(
+        Text2VideoConfig.tiny(sp_axis="sp", sp_strategy="ulysses"),
+        tokenizer=tok(), mesh=mesh)
+    a = uly_pipe.generate(params, ["orbit"], None, [3], **kw)
+    b = uly_pipe.generate(params, ["orbit"], None, [3], **kw)
+    np.testing.assert_array_equal(a, b)  # bit-deterministic
+    diff = np.abs(a.astype(int) - ref.astype(int))
+    assert diff.max() <= 1, diff.max()
+    assert (diff > 0).mean() < 0.02
+
+
+def test_factory_builds_sp_strategy_from_model_config():
+    """The node's config → factory path selects the strategy: a video
+    ModelConfig(sp_strategy=...) reaches the unet on an sp>1 mesh."""
+    from arbius_tpu.node.config import ConfigError, MiningConfig, ModelConfig
+    from arbius_tpu.node.factory import build_registry
+
+    mesh = build_mesh(MeshSpec(sp=2), devices=jax.devices()[:2])
+    mc = ModelConfig(id="0x" + "22" * 32, template="zeroscopev2xl",
+                     tiny=True, sp_strategy="ulysses")
+    reg = build_registry(MiningConfig(models=(mc,)), mesh=mesh)
+    runner = reg.get(mc.id).runner
+    ucfg = runner.pipeline.config.unet
+    assert ucfg.sp_axis == "sp" and ucfg.sp_strategy == "ulysses"
+
+    with pytest.raises(ConfigError, match="sp_strategy"):
+        ModelConfig(id="0x" + "22" * 32, template="zeroscopev2xl",
+                    sp_strategy="nope")
+
+
 def test_pipeline_dp_and_sp_mesh():
     mesh = build_mesh(MeshSpec(dp=2, sp=2), devices=jax.devices()[:4])
     pipe = Text2VideoPipeline(Text2VideoConfig.tiny(sp_axis="sp"),
@@ -199,3 +240,17 @@ def test_ulysses_rejects_indivisible_heads():
         out_specs=P(None, None, "sp", None), check_rep=False)
     with pytest.raises(ValueError, match="divisible"):
         f(q)
+
+
+def test_factory_rejects_ulysses_indivisible_heads_at_boot():
+    """The full zeroscope topology has a 5-head temporal level
+    (320/64); ulysses on sp=2 must be rejected when the registry is
+    BUILT, not at first-task trace time."""
+    from arbius_tpu.node.config import ConfigError, MiningConfig, ModelConfig
+    from arbius_tpu.node.factory import build_registry
+
+    mesh = build_mesh(MeshSpec(sp=2), devices=jax.devices()[:2])
+    mc = ModelConfig(id="0x" + "23" * 32, template="zeroscopev2xl",
+                     tiny=False, sp_strategy="ulysses")
+    with pytest.raises(ConfigError, match="head count"):
+        build_registry(MiningConfig(models=(mc,)), mesh=mesh)
