@@ -147,16 +147,32 @@ class EngineRpcClient:
         return self.send_to(self.engine_address, signature, types, values,
                             gas_limit=gas_limit, value=value)
 
-    def send_to(self, address: str, signature: str, types: list[str],
-                values: list, *, gas_limit: int = 2_000_000,
-                value: int = 0) -> str:
+    def sign_call(self, address: str, signature: str, types: list[str],
+                  values: list, *, gas_limit: int = 2_000_000,
+                  value: int = 0) -> bytes:
+        """Build + sign the EIP-1559 tx WITHOUT sending (nonce/gas read
+        from the endpoint). The one tx-construction path: `send_to` is
+        this + eth_sendRawTransaction, and the CLI's `--sign-only`
+        user-wallet flow returns these bytes for the dapp's raw-tx form."""
         max_fee, priority = self.gas_fees()
         tx = Eip1559Tx(
             chain_id=self.chain_id, nonce=self.nonce(),
             max_priority_fee_per_gas=priority, max_fee_per_gas=max_fee,
             gas_limit=gas_limit, to=address.lower(), value=value,
             data=call_data(signature, types, values))
-        raw = tx.sign(self.wallet)
+        return tx.sign(self.wallet)
+
+    def sign_engine_call(self, fn: str, values: list, *,
+                         gas_limit: int = 2_000_000, value: int = 0) -> bytes:
+        signature, types = ENGINE_FNS[fn]
+        return self.sign_call(self.engine_address, signature, types, values,
+                              gas_limit=gas_limit, value=value)
+
+    def send_to(self, address: str, signature: str, types: list[str],
+                values: list, *, gas_limit: int = 2_000_000,
+                value: int = 0) -> str:
+        raw = self.sign_call(address, signature, types, values,
+                             gas_limit=gas_limit, value=value)
         return self.transport.request("eth_sendRawTransaction",
                                       ["0x" + raw.hex()])
 

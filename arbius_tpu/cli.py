@@ -531,6 +531,17 @@ def cmd_task_submit(args) -> int:
 
         RpcChain(client, dep.token_address).ensure_fee_allowance(fee)
     input_bytes = json.dumps(raw, separators=(",", ":")).encode()
+    if args.sign_only:
+        # user-wallet dapp path (generate.tsx wagmi parity): sign here,
+        # let the node forward the bytes via POST /api/tx/raw. Nonce/gas
+        # are read from the endpoint; nothing is sent. (A nonzero --fee
+        # already sent its approve above — allowance is a separate tx.)
+        raw = client.sign_engine_call("submitTask", [
+            args.version, client.wallet.address, args.model, fee,
+            input_bytes])
+        print(json.dumps({"raw": "0x" + raw.hex(),
+                          "from": client.wallet.address}))
+        return 0
     from_block = client.block_number()
     txhash = client.send("submitTask", [
         args.version, client.wallet.address, args.model, fee, input_bytes])
@@ -805,7 +816,8 @@ def cmd_node_run(args) -> int:
     client = EngineRpcClient(JsonRpcTransport(dep.rpc_url),
                              dep.engine_address, wallet,
                              chain_id=dep.chain_id)
-    chain = RpcChain(client, dep.token_address, start_block=dep.start_block)
+    chain = RpcChain(client, dep.token_address, start_block=dep.start_block,
+                     validator_address=cfg.delegated_validator)
     store = None
     if cfg.store_dir:
         from arbius_tpu.node.store import ContentStore
@@ -934,6 +946,10 @@ def main(argv=None) -> int:
     sp.add_argument("--template", help="validate input against template")
     sp.add_argument("--fee", default="0")
     sp.add_argument("--version", type=int, default=0)
+    sp.add_argument("--sign-only", action="store_true",
+                    help="print the signed raw tx instead of sending it "
+                         "(paste into the dapp's raw-tx form / POST "
+                         "/api/tx/raw — the user-wallet path)")
     sp.set_defaults(fn=cmd_task_submit)
 
     sp = sub.add_parser("task-status", help="task/solution view")

@@ -25,11 +25,17 @@ def _h(b: bytes) -> str:
 
 
 class LocalChain:
-    """The engine as seen by one wallet (`sender`)."""
+    """The engine as seen by one wallet (`sender`).
 
-    def __init__(self, engine: Engine, sender: str):
+    `validator_address` is the delegated-validator seam
+    (blockchain.ts:44-67): stake reads/deposits target it; it defaults
+    to the wallet itself (delegation disabled — reference parity)."""
+
+    def __init__(self, engine: Engine, sender: str,
+                 validator_address: str | None = None):
         self.engine = engine
         self.address = sender.lower()
+        self.validator_address = (validator_address or sender).lower()
 
     # -- chain state -----------------------------------------------------
     @property
@@ -55,11 +61,11 @@ class LocalChain:
         return self.engine.contestations.get(_b(taskid))
 
     def validator_staked(self) -> int:
-        v = self.engine.validators.get(self.address)
+        v = self.engine.validators.get(self.validator_address)
         return v.staked if v else 0
 
     def validator_withdraw_pending(self) -> int:
-        return self.engine.withdraw_pending.get(self.address, 0)
+        return self.engine.withdraw_pending.get(self.validator_address, 0)
 
     def get_validator_minimum(self) -> int:
         return self.engine.get_validator_minimum()
@@ -127,7 +133,7 @@ class LocalChain:
 
     def validator_deposit(self, amount: int) -> None:
         self._tx(lambda: self.engine.validator_deposit(
-            self.address, self.address, amount))
+            self.address, self.validator_address, amount))
 
     def generate_commitment(self, taskid: str, cid: str) -> bytes:
         return self.engine.generate_commitment(self.address, _b(taskid),

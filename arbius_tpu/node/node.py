@@ -105,6 +105,20 @@ class MinerNode:
                 " — update the node (index.ts:960-969)")
         if not skip_self_test:
             self._boot_self_test()
+        delegated = getattr(self.chain, "validator_address", self.chain.address)
+        if delegated != self.chain.address:
+            # the reference's seam exactly (blockchain.ts:44-67, disabled
+            # there too): stake management redirects, but submitSolution
+            # credits/validates msg.sender — so the SIGNER must hold its
+            # own stake to mine until a delegation contract exists.
+            # EngineV1.sol:398-404 gate.
+            log.warning(
+                "delegated_validator %s: stake reads/top-ups target the "
+                "delegated address, but solutions are still submitted (and "
+                "gated on-chain) as the node wallet %s — the wallet itself "
+                "must hold validator stake to mine; delegated SOLVING needs "
+                "the (unshipped) reference solver contract",
+                delegated, self.chain.address)
         self.db.queue_job("validatorStake", {}, priority=100)
         if self.config.automine.enabled:
             self.db.queue_job("automine", {}, priority=10)

@@ -89,13 +89,16 @@ class ControlRPC:
                         self._send(400, {"error": "method required"})
                         return
                     self._send(200, {"id": job_id})
-                elif self.path == "/api/tasks/submit":
+                elif self.path in ("/api/tasks/submit", "/api/tx/raw"):
+                    fn = (outer.submit_task if self.path == "/api/tasks/submit"
+                          else outer.submit_raw_tx)
                     try:
-                        result = outer.submit_task(body)
+                        result = fn(body)
                     except Exception as e:  # noqa: BLE001 — a form submit
                         # must always get a JSON response: bad input
                         # (KeyError/ValueError/TypeError), chain reverts
-                        # (EngineError), endpoint failures (ChainRpcError)
+                        # (EngineError), endpoint failures (ChainRpcError),
+                        # bad raw hex, LocalChain without a raw-tx surface
                         self._send(400, {"error": str(e) or repr(e)})
                         return
                     self._send(200, result)
@@ -187,6 +190,28 @@ class ControlRPC:
         taskid = self.node.chain.submit_task(0, self.node.chain.address,
                                              model_id, fee, input_bytes)
         return {"taskid": taskid or None, "submitted": True}
+
+    def submit_raw_tx(self, body: dict) -> dict:
+        """USER-wallet task submission (the other half of generate.tsx
+        parity): the reference dapp signs with the user's wallet via
+        web3modal/wagmi (`website/src/pages/generate.tsx`); here the dapp
+        posts a user-SIGNED EIP-1559 raw tx and the node forwards it
+        verbatim to its chain endpoint (`eth_sendRawTransaction`) — fee
+        and signature are the user's, never the node's. Requires an
+        RPC-backed chain (RpcChain); an in-process LocalChain has no
+        raw-tx surface to forward to."""
+        raw = body.get("raw")
+        if not isinstance(raw, str) or not raw.startswith("0x"):
+            raise ValueError("raw must be a 0x-hex signed transaction")
+        transport = getattr(getattr(self.node.chain, "client", None),
+                            "transport", None)
+        if transport is None:
+            raise ValueError(
+                "raw-tx passthrough needs an RPC-backed chain (run the "
+                "node against a devnet/live endpoint); the in-process "
+                "LocalChain accepts only node-signed calls")
+        txhash = transport.request("eth_sendRawTransaction", [raw])
+        return {"txhash": txhash, "submitted": True}
 
     _PAGE_STYLE = (
         "body{font-family:system-ui;margin:2rem;max-width:70rem}"
@@ -380,7 +405,19 @@ class ControlRPC:
             "<br><textarea name='input' rows='4'>"
             '{"prompt": "arbius test cat", "negative_prompt": ""}'
             "</textarea><br><button>submit</button> "
-            "<span id='subres'></span></form>")
+            "<span id='subres'></span></form>"
+            # user-wallet path: paste a tx signed with the user's key
+            # (`cli task-submit --sign-only` or any EIP-1559 signer); the
+            # node only forwards it — generate.tsx's wagmi flow without a
+            # JS wallet stack
+            "<h3>…or submit a user-signed raw tx</h3>"
+            "<form onsubmit=\"fetch('/api/tx/raw',{method:'POST',"
+            "body:JSON.stringify({raw:this.raw.value.trim()})})"
+            ".then(r=>r.json()).then(j=>{document.getElementById('rawres')"
+            ".textContent=JSON.stringify(j)});return false\">"
+            "<textarea name='raw' rows='2' "
+            "placeholder='0x02… signed EIP-1559 transaction'></textarea>"
+            "<br><button>forward</button> <span id='rawres'></span></form>")
         return (
             "<!doctype html><html><head><meta charset='utf-8'>"
             "<title>arbius-tpu node</title>"

@@ -58,9 +58,12 @@ class RpcChain:
     """LocalChain-compatible facade over a JSON-RPC endpoint."""
 
     def __init__(self, client: EngineRpcClient, token_address: str,
-                 start_block: int = 0):
+                 start_block: int = 0, validator_address: str | None = None):
         self.client = client
         self.address = client.wallet.address.lower()
+        # delegated-validator seam (blockchain.ts:44-67): stake reads and
+        # deposits target this address; defaults to the signing wallet
+        self.validator_address = (validator_address or self.address).lower()
         self.token_address = token_address.lower()
         self._subs: list[Callable] = []
         self._next_block = start_block
@@ -187,12 +190,13 @@ class RpcChain:
                             finish_start_index=fsi, slash_amount=slash)
 
     def validator_staked(self) -> int:
-        return self._view("validators(address)", ["address"], [self.address],
+        return self._view("validators(address)", ["address"],
+                          [self.validator_address],
                           ["uint256", "uint256", "address"])[0]
 
     def validator_withdraw_pending(self) -> int:
         return self._view("validatorWithdrawPendingAmount(address)",
-                          ["address"], [self.address], ["uint256"])[0]
+                          ["address"], [self.validator_address], ["uint256"])[0]
 
     def get_validator_minimum(self) -> int:
         return self._view("getValidatorMinimum()", [], [], ["uint256"])[0]
@@ -287,7 +291,7 @@ class RpcChain:
                     ["address", "uint256"], [engine, _MAX_UINT256])
             except RpcError as e:
                 raise _engine_error(e) from None
-        self._send("validatorDeposit", [self.address, amount])
+        self._send("validatorDeposit", [self.validator_address, amount])
 
     def generate_commitment(self, taskid: str, cid: str) -> bytes:
         return generate_commitment(self.address, taskid, cid)

@@ -129,3 +129,85 @@ def test_models_page_and_api(dapp):
     html = _get_text(rpc.port, "/models")
     assert "Registered models" in html and mid[:22] in html
     assert "/models" in _get_text(rpc.port, "/")
+
+
+def test_raw_tx_passthrough_spends_user_wallet():
+    """generate.tsx user-wallet parity: a SECOND wallet signs submitTask
+    offline, the dapp POSTs the raw bytes to /api/tx/raw, the node
+    forwards them verbatim — and the devnet-recovered task owner is the
+    USER's address, not the node's. LocalChain nodes reject with a clear
+    error (no raw-tx surface to forward to)."""
+    import urllib.error
+
+    from arbius_tpu.chain import WAD, Engine, TokenLedger
+    from arbius_tpu.chain.devnet import DevnetNode
+    from arbius_tpu.chain.rlp import Eip1559Tx
+    from arbius_tpu.chain.rpc_client import ENGINE_FNS, EngineRpcClient, call_data
+    from arbius_tpu.chain.wallet import Wallet
+    from arbius_tpu.node.config import AutomineConfig, MiningConfig, ModelConfig
+    from arbius_tpu.node.node import MinerNode
+    from arbius_tpu.node.rpc_chain import RpcChain
+    from arbius_tpu.node.solver import ModelRegistry, RegisteredModel
+    from arbius_tpu.templates.engine import load_template
+
+    from test_rpc_chain import CHAIN_ID, DevnetTransport, KEY_MINER, KEY_USER
+
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=1000)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    dev = DevnetNode(eng, chain_id=CHAIN_ID)
+    miner, user = Wallet.from_hex(KEY_MINER), Wallet.from_hex(KEY_USER)
+    tok.mint(miner.address, 1000 * WAD)
+    tok.mint(user.address, 1000 * WAD)
+    mid_bytes = eng.register_model(user.address, user.address, 0,
+                                   b'{"meta":{"title":"t"}}')
+    mid = "0x" + mid_bytes.hex()
+
+    miner_client = EngineRpcClient(DevnetTransport(dev), dev.engine_address,
+                                   miner, chain_id=CHAIN_ID)
+    chain = RpcChain(miner_client, dev.token_address)
+    registry = ModelRegistry()
+    registry.register(RegisteredModel(id=mid,
+                                      template=load_template("anythingv3"),
+                                      runner=fake_runner))
+    cfg = MiningConfig(models=(ModelConfig(id=mid, template="anythingv3"),),
+                       automine=AutomineConfig())
+    node = MinerNode(chain, cfg, registry)
+    rpc = ControlRPC(node, port=0)
+    rpc.start()
+    try:
+        # the user signs submitTask with THEIR key; the node never sees it
+        signature, types = ENGINE_FNS["submitTask"]
+        tx = Eip1559Tx(
+            chain_id=CHAIN_ID, nonce=0, max_priority_fee_per_gas=1,
+            max_fee_per_gas=100, gas_limit=2_000_000,
+            to=dev.engine_address, value=0,
+            data=call_data(signature, types, [
+                0, user.address, mid, 0, b'{"prompt":"mine","negative_prompt":""}']))
+        raw = "0x" + tx.sign(user).hex()
+        res = _post(rpc.port, "/api/tx/raw", {"raw": raw})
+        assert res["submitted"] and res["txhash"].startswith("0x")
+        task = next(iter(eng.tasks.values()))
+        assert task.owner == user.address.lower()
+
+        # malformed input: clean 400, nothing forwarded
+        import pytest as _pytest
+        with _pytest.raises(urllib.error.HTTPError) as e:
+            _post(rpc.port, "/api/tx/raw", {"raw": "not hex"})
+        assert e.value.code == 400
+    finally:
+        rpc.stop()
+
+
+def test_raw_tx_rejected_on_localchain(dapp):
+    import urllib.error
+
+    eng, chain, node, rpc, mid = dapp
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{rpc.port}/api/tx/raw",
+        data=json.dumps({"raw": "0x02dead"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 400
+    assert len(eng.tasks) == 0

@@ -345,3 +345,40 @@ def test_db_prune_keeps_unclaimed():
     assert removed == 1
     assert node.db.get_task(t_old) is None
     assert node.db.get_task(t_new) is not None
+
+
+def test_delegated_validator_stake_seam():
+    """blockchain.ts:44-67 seam: with `delegated_validator` configured,
+    stake reads AND the auto-top-up deposit target the delegated address
+    (validatorDeposit is anyone-may-top-up, EngineV1.sol:581-604); the
+    node's own wallet pays but never accrues stake."""
+    delegated = "0x" + "dd" * 20
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=10_000)
+    tok.mint(Engine.ADDRESS, 590_000 * WAD)   # supply 10k → minimum 8
+    tok.mint(MINER, 1_000 * WAD)
+    tok.approve(MINER, Engine.ADDRESS, 10**30)
+    chain = LocalChain(eng, MINER, validator_address=delegated)
+    node = MinerNode(chain, MiningConfig(delegated_validator=delegated),
+                     ModelRegistry())
+    import logging
+    records = []
+    h = logging.Handler()
+    h.emit = records.append
+    logging.getLogger("arbius.node").addHandler(h)
+    try:
+        node.boot()
+    finally:
+        logging.getLogger("arbius.node").removeHandler(h)
+    # the solving-gate caveat must be surfaced at boot, not at first revert
+    assert any("delegated_validator" in r.getMessage() for r in records)
+    drain(node)
+    minimum = eng.get_validator_minimum()
+    assert eng.validators[delegated].staked >= minimum
+    assert MINER not in eng.validators
+    # facade reads report the delegated stake
+    assert chain.validator_staked() == eng.validators[delegated].staked
+
+    from arbius_tpu.node.config import ConfigError
+    with pytest.raises(ConfigError, match="delegated_validator"):
+        MiningConfig(delegated_validator="not-an-address")

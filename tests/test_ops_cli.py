@@ -549,3 +549,34 @@ def test_owner_sets_parameter_directly(world, capsys):
     with pytest.raises(RpcError, match="not owner"):
         bad.send_to(dev.engine_address,
                     "setSolutionFeePercentage(uint256)", ["uint256"], [1])
+
+
+def test_task_submit_sign_only_roundtrip(world, capsys):
+    """`task-submit --sign-only` prints a raw EIP-1559 tx instead of
+    sending; forwarding those bytes via eth_sendRawTransaction lands the
+    task under the SIGNER's address — the CLI half of the dapp's
+    /api/tx/raw user-wallet path."""
+    eng, dev, operator, miner, dep = world
+    base = ["--deployment", dep]
+    reg = run_cli(capsys, ["model-register", *base,
+                           "--key", "0x" + operator.private_key.hex(),
+                           "--template", "anythingv3"])
+    mid = reg["model_id"]
+
+    out = run_cli(capsys, ["task-submit", *base,
+                           "--key", "0x" + operator.private_key.hex(),
+                           "--model", mid, "--template", "anythingv3",
+                           "--input", json.dumps({
+                               "prompt": "signed offline",
+                               "negative_prompt": ""}),
+                           "--sign-only"])
+    assert out["raw"].startswith("0x02")
+    assert out["from"] == operator.address
+    n_before = len(eng.tasks)
+
+    client = EngineRpcClient(JsonRpcTransport(dep_url(dep)),
+                             dev.engine_address, miner, chain_id=CHAIN_ID)
+    client.transport.request("eth_sendRawTransaction", [out["raw"]])
+    assert len(eng.tasks) == n_before + 1
+    task = list(eng.tasks.values())[-1]
+    assert task.owner == operator.address.lower()
