@@ -39,3 +39,61 @@ def test_sd15_dp_mesh_batch_divisibility():
         assert "divisible" in str(e)
     else:
         raise AssertionError("expected divisibility error")
+
+
+def test_pp_over_sd15_text_encoder_layers():
+    """Pipeline parallelism on a production SD-1.5 module: the text
+    encoder's identical-layer stack split over pp=2 (its 12-layer ViT-L
+    stack is the flagship's natural layer-stack pipeline; the UNet's
+    levels change activation shape and belong to tp/dp). Composes pp×dp:
+    microbatch batch dim sharded over dp. Must equal the plain forward
+    bitwise-tolerably."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    from arbius_tpu.models.sd15.text_encoder import (
+        TextEncoder,
+        TextEncoderConfig,
+        _EncoderLayer,
+    )
+    from arbius_tpu.parallel import pipeline_apply, stack_stage_params
+
+    cfg = TextEncoderConfig(vocab_size=64, max_length=8, width=16,
+                            layers=4, heads=2, dtype="float32")
+    enc = TextEncoder(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 64)
+    params = enc.init(jax.random.PRNGKey(0), ids)["params"]
+    ref = np.asarray(enc.apply({"params": params}, ids))
+
+    mesh = build_mesh(MeshSpec(pp=2, dp=2), devices=jax.devices()[:4])
+    S = mesh.shape["pp"]
+    k = cfg.layers // S
+
+    class Stage(nn.Module):
+        """k consecutive encoder layers — every stage same signature."""
+        @nn.compact
+        def __call__(self, x):
+            mask = nn.make_causal_mask(jnp.zeros(x.shape[:2], jnp.int32))
+            for i in range(k):
+                x = _EncoderLayer(cfg, name=f"layer_{i}")(x, mask)
+            return x
+
+    stage = Stage()
+    stacked = stack_stage_params([
+        {f"layer_{j}": params[f"layer_{s * k + j}"] for j in range(k)}
+        for s in range(S)])
+
+    # embeddings / final norm sit outside the pipelined stack, exactly as
+    # TextEncoder computes them
+    x = (params["token_embed"]["embedding"][ids]
+         + params["pos_embed"][None, : ids.shape[1]])
+    mid = pipeline_apply(
+        lambda p, h: stage.apply({"params": p}, h),
+        stacked, x.astype(jnp.float32), mesh, axis="pp",
+        microbatches=2, batch_axis="dp")
+    fin = params["final_norm"]
+    out = nn.LayerNorm(epsilon=1e-5).apply(
+        {"params": {"scale": fin["scale"], "bias": fin["bias"]}},
+        jnp.asarray(mid))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
