@@ -255,3 +255,83 @@ def test_resnet_block_matches_torch_reference():
         {"params": params}, x_nhwc, jnp.asarray(temb.numpy())))
     np.testing.assert_allclose(ours.transpose(0, 3, 1, 2), theirs,
                                atol=ATOL, rtol=RTOL)
+
+
+class _TorchSpatialNorm(torch.nn.Module):
+    """diffusers SpatialNorm semantics (the MOVQ norm): GroupNorm(f)
+    modulated by 1x1-conv scale/shift predicted from the nearest-upsampled
+    quantized latent."""
+
+    def __init__(self, f_channels: int, zq_channels: int):
+        super().__init__()
+        self.norm_layer = torch.nn.GroupNorm(int(np.gcd(f_channels, 32)),
+                                             f_channels, eps=1e-6)
+        self.conv_y = torch.nn.Conv2d(zq_channels, f_channels, 1)
+        self.conv_b = torch.nn.Conv2d(zq_channels, f_channels, 1)
+
+    def forward(self, f, zq):
+        zq = torch.nn.functional.interpolate(zq, size=f.shape[-2:],
+                                             mode="nearest")
+        return self.norm_layer(f) * self.conv_y(zq) + self.conv_b(zq)
+
+
+def test_movq_spatial_norm_matches_torch_reference():
+    """The MOVQ decoder's SpatialNorm through the converter's leaf table
+    (_spatial_norm_leaves transforms) ≡ the published formula."""
+    from arbius_tpu.models.kandinsky2.movq import SpatialNorm
+
+    torch.manual_seed(5)
+    cf, cz = 8, 4
+    tm = _TorchSpatialNorm(cf, cz).eval()
+    f = torch.randn(2, cf, 8, 8)
+    zq = torch.randn(2, cz, 4, 4)   # exercises the nearest upsample
+    with torch.no_grad():
+        theirs = tm(f, zq).numpy()
+
+    # drive the ACTUAL converter leaf table: flax path -> (published key,
+    # transform) — a same-shape swap in the table must fail this test
+    from arbius_tpu.models.kandinsky2.convert import _spatial_norm_leaves
+
+    sd = {k: v.detach().numpy() for k, v in tm.state_dict().items()}
+    params = {}
+    for path in ("norm/GroupNorm_0/scale", "norm/GroupNorm_0/bias",
+                 "conv_y/kernel", "conv_y/bias",
+                 "conv_b/kernel", "conv_b/bias"):
+        key, tf = _spatial_norm_leaves(path)
+        node = params
+        parts = path.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = tf(sd[key])
+    ours = np.asarray(SpatialNorm(jnp.float32).apply(
+        {"params": params},
+        jnp.asarray(f.numpy().transpose(0, 2, 3, 1)),
+        jnp.asarray(zq.numpy().transpose(0, 2, 3, 1))))
+    np.testing.assert_allclose(ours.transpose(0, 3, 1, 2), theirs,
+                               atol=ATOL, rtol=RTOL)
+
+
+def test_temporal_conv3d_transform_matches_torch():
+    """The video converter's _tconv3d: a torch Conv3d with (3,1,1) kernel
+    over [B, C, T, H, W] ≡ our frame-axis (3,) conv over [B, H, W, T, C]
+    with the transformed kernel — the TemporalConvLayer hot path."""
+    from arbius_tpu.models.video.convert import _tconv3d
+
+    torch.manual_seed(6)
+    ci, co, T, H, W = 4, 6, 5, 3, 3
+    tc = torch.nn.Conv3d(ci, co, (3, 1, 1), padding=(1, 0, 0))
+    x = torch.randn(2, ci, T, H, W)
+    with torch.no_grad():
+        theirs = tc(x).numpy()  # [B, co, T, H, W]
+
+    import flax.linen as nn
+
+    conv = nn.Conv(co, (3,), padding=[(1, 1)], dtype=jnp.float32)
+    params = {"kernel": _tconv3d(tc.weight.detach().numpy()),
+              "bias": tc.bias.detach().numpy()}
+    # [B, C, T, H, W] -> [B, H, W, T, C] (the layout TemporalConvLayer
+    # convolves in), back after
+    x_f = jnp.asarray(x.numpy().transpose(0, 3, 4, 2, 1))
+    ours = np.asarray(conv.apply({"params": params}, x_f))
+    np.testing.assert_allclose(ours.transpose(0, 4, 3, 1, 2), theirs,
+                               atol=ATOL, rtol=RTOL)
