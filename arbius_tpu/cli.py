@@ -384,6 +384,22 @@ def cmd_record_golden(args) -> int:
 
     raw = (json.loads(args.input) if args.input
            else {"prompt": "arbius test cat", "negative_prompt": ""})
+    resolve_file = None
+    if args.template == "robust_video_matting" and not args.probe_video:
+        raise SystemExit(
+            "robust_video_matting's input is a video FILE: pass "
+            "--probe-video TxHxW to pin the deterministic in-repo probe "
+            "clip as input_video (codecs/probe.py)")
+    if args.probe_video:
+        # file-input templates: pin the deterministic in-repo probe clip
+        # by CID and resolve it in-memory — the recorded golden's
+        # input_video reproduces bit-identically on any platform
+        from arbius_tpu.node.factory import probe_resolver
+
+        resolve_file, clip_cid = probe_resolver(args.probe_video)
+        raw.pop("prompt", None)
+        raw.pop("negative_prompt", None)
+        raw["input_video"] = clip_cid
     mid = args.model_id or "0x" + "00" * 32
     mc = ModelConfig(
         id=mid, template=args.template, tiny=args.tiny,
@@ -391,16 +407,23 @@ def cmd_record_golden(args) -> int:
         weights_dtype=args.weights_dtype,
         tokenizer="clip_bpe" if args.vocab else "byte",
         vocab_path=args.vocab, merges_path=args.merges)
-    m = build_registry(MiningConfig(models=(mc,))).get(mid)
+    m = build_registry(MiningConfig(models=(mc,)),
+                       resolve_file=resolve_file).get(mid)
     hydrated = hydrate_input(dict(raw), m.template)
     platform = jax.devices()[0].platform
     t0 = time.perf_counter()
     cid, _files = solve_cid(m, hydrated, args.seed)
+    golden = {"input": raw, "seed": args.seed, "cid": cid}
+    if args.probe_video:
+        # regeneration recipe IN the vector: a node whose golden carries
+        # probe_video synthesizes the clip at boot (factory.probe_resolver)
+        # — the artifact is reproducible without any pre-pinned store
+        golden["probe_video"] = args.probe_video
     print(json.dumps({
         "template": args.template, "platform": platform,
         "tiny": args.tiny, "weights_dtype": args.weights_dtype,
         "elapsed_s": round(time.perf_counter() - t0, 1),
-        "golden": {"input": raw, "seed": args.seed, "cid": cid},
+        "golden": golden,
     }))
     return 0
 
@@ -888,9 +911,15 @@ def main(argv=None) -> int:
         help="compute a model's boot self-test golden CID on this platform")
     sp.add_argument("--template", required=True,
                     choices=["anythingv3", "kandinsky2", "zeroscopev2xl",
-                             "damo"])  # file-input templates need a node
+                             "damo", "robust_video_matting"])
     sp.add_argument("--input", help='hydratable input JSON (default: '
                                     '{"prompt": "arbius test cat", ...})')
+    sp.add_argument("--probe-video", metavar="TxHxW",
+                    help="file-input templates (robust_video_matting): "
+                         "generate the deterministic in-repo probe clip at "
+                         "this shape, pin it by CID, and use it as "
+                         "input_video — any platform reproduces the same "
+                         "input bytes, so the golden stays portable")
     sp.add_argument("--seed", type=int, default=1337)  # index.ts:988
     sp.add_argument("--tiny", action="store_true")
     sp.add_argument("--checkpoint", help="orbax params (default: random init)")

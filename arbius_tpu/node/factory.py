@@ -122,9 +122,35 @@ def _video(m: ModelConfig, mesh):
     return Text2VideoRunner(pipe, _params_for(pipe, m))
 
 
+def probe_resolver(shape: str, base=None):
+    """cid→bytes resolver that synthesizes the deterministic probe clip
+    for its own CID and defers everything else to `base`. Makes a
+    file-input golden self-contained: a ModelConfig.golden carrying
+    `probe_video: "TxHxW"` boot-self-tests without the clip pre-pinned
+    in any store (codecs/probe.py — same bytes on every platform)."""
+    from arbius_tpu.codecs import encode_mp4
+    from arbius_tpu.codecs.probe import probe_clip
+    from arbius_tpu.l0.base58 import b58encode
+    from arbius_tpu.l0.cid import dag_of_file
+
+    t, h, w = (int(x) for x in shape.lower().split("x"))
+    blob = encode_mp4(probe_clip(t, h, w), fps=8)
+    pcid = b58encode(dag_of_file(blob).cid)
+
+    def resolve(cid):
+        if cid == pcid:
+            return blob
+        return base(cid) if base is not None else None
+
+    return resolve, pcid
+
+
 def _rvm(m: ModelConfig, mesh, resolve_file):
     from arbius_tpu.models.rvm import RVMPipeline, RVMPipelineConfig
 
+    probe = (m.golden or {}).get("probe_video")
+    if probe:
+        resolve_file, _ = probe_resolver(probe, base=resolve_file)
     cfg = RVMPipelineConfig.tiny() if m.tiny else RVMPipelineConfig()
     pipe = RVMPipeline(cfg)
     return RVMRunner(pipe, _params_for(pipe, m), resolve_file)
@@ -151,9 +177,10 @@ def build_registry(cfg: MiningConfig, *, mesh=None,
         if not m.enabled:
             continue
         if m.template == "robust_video_matting":
-            if resolve_file is None:
+            if resolve_file is None and not (m.golden or {}).get("probe_video"):
                 log.warning("model %s: robust_video_matting needs a "
-                            "resolve_file; skipping", m.id)
+                            "resolve_file (or a probe_video golden); "
+                            "skipping", m.id)
                 continue
             runner = _rvm(m, mesh, resolve_file)
         elif m.template in _BUILDERS:

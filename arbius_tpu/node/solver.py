@@ -207,9 +207,12 @@ class RVMRunner:
         from arbius_tpu.codecs.mp4_demux import decode_mjpeg_mp4
 
         video = decode_mjpeg_mp4(self.resolve_file(hydrated["input_video"]))
+        # the template's output_type enum includes "" as its default
+        # choice (templates/robust_video_matting.json) — the published
+        # model treats empty as green-screen
         out = self.pipeline.matte(
             self.params, video,
-            output_type=hydrated.get("output_type", "green-screen"))
+            output_type=hydrated.get("output_type") or "green-screen")
         return {self.out_name: encode_mp4(out, fps=self.fps)}
 
 

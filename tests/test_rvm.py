@@ -130,3 +130,85 @@ def test_matted_video_to_mp4():
     out = pipe.matte(params, synth_video(), output_type="green-screen")
     mp4 = encode_mp4(out, fps=8)
     assert mp4[4:8] == b"ftyp" and encode_mp4(out, fps=8) == mp4
+
+
+def test_probe_clip_deterministic_and_golden_recordable():
+    """File-input golden path: the probe clip is bit-deterministic
+    (platform-independent integer ops) and `record-golden --probe-video`
+    produces a stable CID for the tiny RVM end-to-end."""
+    import json
+
+    from arbius_tpu.codecs import encode_mp4
+    from arbius_tpu.codecs.probe import probe_clip
+
+    a, b = probe_clip(4, 32, 32), probe_clip(4, 32, 32)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (4, 32, 32, 3) and a.dtype == np.uint8
+    assert encode_mp4(a, fps=8) == encode_mp4(b, fps=8)
+
+    import contextlib
+    import io
+
+    from arbius_tpu.cli import main
+
+    runs = []
+    for _ in range(2):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert main(["record-golden", "--template",
+                         "robust_video_matting", "--tiny",
+                         "--probe-video", "4x32x32"]) == 0
+        runs.append(json.loads(buf.getvalue().strip()))
+    assert runs[0]["golden"]["cid"] == runs[1]["golden"]["cid"]
+    assert runs[0]["golden"]["input"]["input_video"].startswith("Qm")
+
+
+def test_boot_self_test_with_probe_golden_and_no_store():
+    """Self-contained file-input golden: a ModelConfig.golden carrying
+    probe_video boots a node with NO content store — the factory
+    synthesizes the pinned clip for its own CID at boot. Wrong-CID
+    goldens still fail loudly (BootError, not a crash)."""
+    import contextlib
+    import io
+    import json
+
+    import pytest
+
+    from arbius_tpu.chain import Engine, TokenLedger, WAD
+    from arbius_tpu.cli import main
+    from arbius_tpu.node import (
+        BootError,
+        LocalChain,
+        MinerNode,
+        MiningConfig,
+        ModelConfig,
+    )
+    from arbius_tpu.node.factory import build_registry
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["record-golden", "--template", "robust_video_matting",
+                     "--tiny", "--probe-video", "4x32x32"]) == 0
+    rec = json.loads(buf.getvalue().strip())
+    assert rec["golden"]["probe_video"] == "4x32x32"
+
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=10_000)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    miner = "0x" + "aa" * 20
+    tok.mint(miner, 1_000 * WAD)
+    tok.approve(miner, Engine.ADDRESS, 10**30)
+    mid = "0x" + eng.register_model(miner, miner, 0, b'{"m":1}').hex()
+
+    def world(golden):
+        cfgm = ModelConfig(id=mid, template="robust_video_matting",
+                           tiny=True, golden=golden)
+        cfg = MiningConfig(models=(cfgm,))
+        # no resolve_file, no store: the probe golden is all it has
+        return MinerNode(LocalChain(eng, miner), cfg, build_registry(cfg))
+
+    world(rec["golden"]).boot()  # green
+
+    bad = dict(rec["golden"], cid="0x1220" + "ab" * 32)
+    with pytest.raises(BootError, match="self-test failed"):
+        world(bad).boot()
