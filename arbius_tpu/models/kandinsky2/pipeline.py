@@ -101,7 +101,12 @@ class Kandinsky2Pipeline:
         self._buckets: dict[tuple, object] = {}
 
     # -- params ----------------------------------------------------------
-    def init_params(self, seed: int = 0, height: int = 64, width: int = 64) -> dict:
+    def init_params(self, seed: int = 0, height: int = 64, width: int = 64,
+                    dtype=None) -> dict:
+        """One jitted init program; `dtype` folds the weights cast in so
+        the full f32 tree is never resident (the ~3B tree is 12 GB f32 —
+        a separate cast program OOMs a 16 GB chip; fused, XLA frees each
+        f32 leaf at its convert)."""
         cfg = self.config
         lh, lw = height // self.MOVQ_FACTOR, width // self.MOVQ_FACTOR
 
@@ -124,7 +129,9 @@ class Kandinsky2Pipeline:
                 "movq": self.movq.init(k4, lat)["params"],
             }
 
-        return jax.jit(_init)(jax.random.PRNGKey(seed))
+        from arbius_tpu.utils import with_cast
+
+        return jax.jit(with_cast(_init, dtype))(jax.random.PRNGKey(seed))
 
     def place_params(self, params: dict, tp_rules=None) -> dict:
         if self.mesh is None:

@@ -63,16 +63,23 @@ class RVMPipeline:
         return snap(height), snap(width)
 
     def init_params(self, seed: int = 0, height: int = 64,
-                    width: int = 64) -> dict:
-        frame = jnp.zeros((1, height, width, 3))
+                    width: int = 64, dtype=None) -> dict:
+        """One jitted init program; `dtype` folds the weights cast in
+        (see SD15Pipeline.init_params for the HBM-peak rationale)."""
         # init through the downsample+refine path so the refiner's
         # published weights are materialized in the tree; base snapped to
         # the granule like base_hw does
         g = self.GRANULE
         base = (max(g, height // 2 // g * g), max(g, width // 2 // g * g))
-        rec = self.step.init_rec(1, *base)
-        return self.step.init(jax.random.PRNGKey(seed), frame, rec,
-                              base)["params"]
+
+        def _init(key):
+            frame = jnp.zeros((1, height, width, 3))
+            rec = self.step.init_rec(1, *base)
+            return self.step.init(key, frame, rec, base)["params"]
+
+        from arbius_tpu.utils import with_cast
+
+        return jax.jit(with_cast(_init, dtype))(jax.random.PRNGKey(seed))
 
     def compiled_bucket(self, frames: int, height: int, width: int):
         key = (frames, height, width)

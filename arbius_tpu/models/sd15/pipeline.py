@@ -74,7 +74,8 @@ class SD15Pipeline:
         self._buckets: dict[tuple, object] = {}
 
     # -- params ----------------------------------------------------------
-    def init_params(self, seed: int = 0, height: int = 64, width: int = 64) -> dict:
+    def init_params(self, seed: int = 0, height: int = 64, width: int = 64,
+                    dtype=None) -> dict:
         """Deterministic parameter init (stands in for converted weights).
 
         The whole init is one jitted XLA program so parameters materialize
@@ -82,10 +83,16 @@ class SD15Pipeline:
         of small ops one-by-one, which is pathological over a remote-TPU
         tunnel (each dispatch is a round-trip), and host-side init would
         need a multi-GB host→HBM transfer afterwards. Same bits either way
-        (JAX PRNG is algorithmically deterministic under jit)."""
+        (JAX PRNG is algorithmically deterministic under jit).
+
+        `dtype` folds the weights cast into the SAME program via
+        utils.with_cast (HBM-peak rationale in its docstring)."""
+        from arbius_tpu.utils import with_cast
+
         lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
 
-        return jax.jit(self._init_fn(lh, lw))(jax.random.PRNGKey(seed))
+        return jax.jit(with_cast(self._init_fn(lh, lw), dtype))(
+            jax.random.PRNGKey(seed))
 
     def _init_fn(self, lh: int, lw: int):
         def _init(key):

@@ -83,22 +83,32 @@ class Text2VideoPipeline:
 
     # -- params ----------------------------------------------------------
     def init_params(self, seed: int = 0, frames: int = 2, height: int = 64,
-                    width: int = 64) -> dict:
+                    width: int = 64, dtype=None) -> dict:
         """Init with sp_axis disabled (collectives need a mesh); the param
-        tree is identical either way, so these params drive both paths."""
+        tree is identical either way, so these params drive both paths.
+
+        One jitted program (eager flax init is a per-op round-trip over a
+        remote-TPU tunnel); `dtype` folds the weights cast in so the f32
+        tree is never fully resident (see SD15Pipeline.init_params)."""
         cfg = self.config
-        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
         lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
-        lat = jnp.zeros((1, frames, lh, lw, cfg.unet.in_channels))
-        ids = jnp.zeros((1, cfg.text.max_length), jnp.int32)
-        ctx = jnp.zeros((1, cfg.text.max_length, cfg.unet.context_dim))
         unet_local = UNet3DCondition(
             dataclasses.replace(cfg.unet, sp_axis=None))
-        return {
-            "unet": unet_local.init(k1, lat, jnp.zeros((1,)), ctx)["params"],
-            "vae": self.vae.init(k2, lat[:, 0])["params"],
-            "text": self.text_encoder.init(k3, ids)["params"],
-        }
+
+        def _init(key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            lat = jnp.zeros((1, frames, lh, lw, cfg.unet.in_channels))
+            ids = jnp.zeros((1, cfg.text.max_length), jnp.int32)
+            ctx = jnp.zeros((1, cfg.text.max_length, cfg.unet.context_dim))
+            return {
+                "unet": unet_local.init(k1, lat, jnp.zeros((1,)), ctx)["params"],
+                "vae": self.vae.init(k2, lat[:, 0])["params"],
+                "text": self.text_encoder.init(k3, ids)["params"],
+            }
+
+        from arbius_tpu.utils import with_cast
+
+        return jax.jit(with_cast(_init, dtype))(jax.random.PRNGKey(seed))
 
     def place_params(self, params: dict, tp_rules=()) -> dict:
         """Video path shards dp×sp via shard_map with replicated params

@@ -27,23 +27,32 @@ log = logging.getLogger("arbius.factory")
 
 
 def _params_for(pipe, m: ModelConfig):
+    dtype = "bfloat16" if m.weights_dtype == "bfloat16" else None
     if m.checkpoint:
         from arbius_tpu.utils import load_params
 
         params = load_params(m.checkpoint)
-    else:
-        log.warning("model %s: no checkpoint configured, using random init",
-                    m.id)
-        params = pipe.init_params(seed=0)
-    if m.weights_dtype == "bfloat16":
-        import jax
+        if dtype is not None:
+            import jax
 
-        from arbius_tpu.utils import cast_floating
+            from arbius_tpu.utils import cast_floating
 
-        # one jitted program: eager per-leaf casts would dispatch one op
-        # per leaf over a remote-TPU transport (the round-2 failure mode)
-        params = jax.jit(lambda p: cast_floating(p, "bfloat16"))(params)
-    return params
+            # one jitted program: eager per-leaf casts would dispatch one
+            # op per leaf over a remote-TPU transport (the round-2 failure
+            # mode). Production checkpoints should be STORED in the pinned
+            # dtype (convert-checkpoint --dtype), making this a no-op —
+            # but when it isn't, donation lets XLA free each f32 leaf at
+            # its convert instead of holding both full trees live (the
+            # 16 GB-chip OOM the random-init path fixes via with_cast)
+            params = jax.jit(lambda p: cast_floating(p, dtype),
+                             donate_argnums=0)(params)
+        return params
+    log.warning("model %s: no checkpoint configured, using random init",
+                m.id)
+    # dtype folds the cast into the init program: a separate cast program
+    # holds BOTH trees live (f32 + bf16 — 18 GB for the ~3B kandinsky
+    # tree) and OOMs a 16 GB chip; fused, each f32 leaf dies at its cast
+    return pipe.init_params(seed=0, dtype=dtype)
 
 
 def _tokenizer_for(m: ModelConfig, text_cfg):

@@ -4,8 +4,9 @@ from arbius_tpu.utils.checkpoint import (
     enable_compile_cache,
     load_params,
     save_params,
+    with_cast,
 )
 from arbius_tpu.utils.platform import force_cpu_devices
 
 __all__ = ["cast_floating", "enable_compile_cache", "force_cpu_devices",
-           "load_params", "save_params"]
+           "load_params", "save_params", "with_cast"]

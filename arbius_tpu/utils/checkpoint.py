@@ -60,3 +60,15 @@ def cast_floating(params: dict, dtype) -> dict:
         return x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.inexact) else x
 
     return jax.tree_util.tree_map(cast, params)
+
+
+def with_cast(init_fn, dtype):
+    """Wrap a param-init closure so an optional weights cast runs INSIDE
+    the same XLA program. Init always computes in f32 (identical bits to
+    init-then-cast), but fused, XLA frees each f32 leaf at its convert —
+    a SEPARATE cast program holds both full trees live at once, which
+    OOMed the ~3B kandinsky tree on a 16 GB chip (12 GB f32 + 6 GB bf16).
+    `dtype=None` returns init_fn unchanged."""
+    if dtype is None:
+        return init_fn
+    return lambda key: cast_floating(init_fn(key), dtype)

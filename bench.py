@@ -511,6 +511,10 @@ def _record_goldens(hb: _Heartbeat, left) -> None:
         ("anythingv3", "bfloat16", metric_shape, 420),
         ("anythingv3", "float32", metric_shape, 360),
         ("kandinsky2", "bfloat16", {}, 900),
+        # video family at the CPU-golden shape (cross-platform row pair)
+        ("zeroscopev2xl", "bfloat16",
+         {"negative_prompt": "", "num_frames": 2, "width": 256,
+          "height": 256, "num_inference_steps": 2}, 600),
     ]
     for template, dtype, overrides, need in jobs:
         if left() < need:

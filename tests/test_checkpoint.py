@@ -36,3 +36,42 @@ def test_enable_compile_cache(tmp_path):
     # config took effect (idempotent re-set is fine too)
     assert jax.config.jax_compilation_cache_dir == cache
     enable_compile_cache(cache)
+
+
+def test_fused_init_cast_matches_separate_cast():
+    """init_params(dtype=) must be bit-identical to init-then-cast.
+
+    The fused form exists for HBM peak (a separate cast program holds the
+    f32 AND bf16 trees live at once — it OOMed the ~3B kandinsky tree on
+    a 16 GB chip), but goldens were recorded via the two-program path, so
+    the bits must not move. Covers every pipeline family's init path.
+    """
+    import jax.numpy as jnp
+
+    from arbius_tpu.models.kandinsky2 import Kandinsky2Config, Kandinsky2Pipeline
+    from arbius_tpu.models.rvm import RVMPipeline, RVMPipelineConfig
+    from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline
+    from arbius_tpu.models.video import Text2VideoConfig, Text2VideoPipeline
+    from arbius_tpu.utils import cast_floating
+
+    pipes = [
+        SD15Pipeline(SD15Config.tiny()),
+        Kandinsky2Pipeline(Kandinsky2Config.tiny()),
+        Text2VideoPipeline(Text2VideoConfig.tiny()),
+        RVMPipeline(RVMPipelineConfig.tiny()),
+    ]
+    for pipe in pipes:
+        ref = jax.jit(lambda p: cast_floating(p, "bfloat16"))(
+            pipe.init_params(seed=0))
+        fused = pipe.init_params(seed=0, dtype="bfloat16")
+        leaves_ref = jax.tree_util.tree_leaves_with_path(ref)
+        leaves_fused = jax.tree_util.tree_leaves_with_path(fused)
+        assert len(leaves_ref) == len(leaves_fused)
+        for (path_r, a), (path_f, b) in zip(leaves_ref, leaves_fused):
+            assert path_r == path_f
+            assert a.dtype == b.dtype, (type(pipe).__name__, path_r)
+            if jnp.issubdtype(a.dtype, jnp.inexact):
+                assert a.dtype == jnp.bfloat16, (type(pipe).__name__, path_r)
+            np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                err_msg=f"{type(pipe).__name__} {path_r}")
