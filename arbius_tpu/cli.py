@@ -394,12 +394,12 @@ def cmd_record_golden(args) -> int:
         # file-input templates: pin the deterministic in-repo probe clip
         # by CID and resolve it in-memory — the recorded golden's
         # input_video reproduces bit-identically on any platform
-        from arbius_tpu.node.factory import probe_resolver
+        from arbius_tpu.node.factory import probe_golden_input
 
-        resolve_file, clip_cid = probe_resolver(args.probe_video)
+        resolve_file, probe_raw = probe_golden_input(args.probe_video)
         raw.pop("prompt", None)
         raw.pop("negative_prompt", None)
-        raw["input_video"] = clip_cid
+        raw.update(probe_raw)
     mid = args.model_id or "0x" + "00" * 32
     mc = ModelConfig(
         id=mid, template=args.template, tiny=args.tiny,

@@ -154,6 +154,16 @@ def probe_resolver(shape: str, base=None):
     return resolve, pcid
 
 
+def probe_golden_input(shape: str):
+    """(resolver, raw-input) pair for recording a file-input golden
+    against the deterministic probe clip. The ONE definition of what a
+    probe-recorded vector's input looks like — record-golden (CLI) and
+    bench's golden session both use it, so CPU- and TPU-recorded rows of
+    the same shape can never drift apart structurally."""
+    resolve_file, clip_cid = probe_resolver(shape)
+    return resolve_file, {"input_video": clip_cid}
+
+
 def _rvm(m: ModelConfig, mesh, resolve_file):
     from arbius_tpu.models.rvm import RVMPipeline, RVMPipelineConfig
 

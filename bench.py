@@ -403,9 +403,12 @@ def _stage_session(out_path: str) -> None:
         "elapsed_s": round(time.perf_counter() - _T0, 1),
     })
 
+    goldens_only = os.environ.get("BENCH_GOLDENS_ONLY", "0") == "1"
     pipe = SD15Pipeline(SD15Config(), tokenizer=ByteTokenizer())
     params = params16 = None
-    if left() > 240:
+    if goldens_only:
+        _note("BENCH_GOLDENS_ONLY=1: skipping measurement stages")
+    elif left() > 240:
         hb.set("init_params (full 860M-class, jitted on-device)")
         t_init = time.perf_counter()
         params = pipe.init_params(seed=0, height=HEIGHT, width=WIDTH)
@@ -478,9 +481,9 @@ def _stage_session(out_path: str) -> None:
             {"batch_sweep": sweep} if sweep else None))
 
     # -- goldens: admission vectors on this chip, while we hold it --------
-    if left() > 420 and os.environ.get("BENCH_RECORD_GOLDENS", "1") != "0":
+    if left() > 120 and os.environ.get("BENCH_RECORD_GOLDENS", "1") != "0":
         try:
-            _record_goldens(hb, left)
+            _record_goldens(hb, left, only_missing=goldens_only)
         except Exception as e:  # goldens are a bonus — never fail the bench
             _note(f"golden recording failed: {type(e).__name__}: {e}")
     hb.stop()
@@ -488,11 +491,19 @@ def _stage_session(out_path: str) -> None:
     _arm_exit_watchdog(90.0)
 
 
-def _record_goldens(hb: _Heartbeat, left) -> None:
+def _record_goldens(hb: _Heartbeat, left, only_missing: bool = False) -> None:
     """Record boot-self-test golden CIDs on the claimed chip at template
     default (production) shapes, written straight into goldens/. The
     repo's analogue of the reference's pinned admission CID
-    (miner/src/index.ts:984-1001)."""
+    (miner/src/index.ts:984-1001).
+
+    `only_missing` (the BENCH_GOLDENS_ONLY session mode): skip rows whose
+    vector file already exists, so a short claim spends its whole budget
+    on absent rows instead of re-verifying expensive existing ones.
+    Each job is individually fault-isolated: a transient pool error on
+    one compile must not cost the cheaper jobs behind it (a session-3
+    postmortem: a 28-min anythingv3 recompile died UNAVAILABLE and took
+    the never-attempted damo/RVM rows with it)."""
     import jax
 
     from arbius_tpu.node.config import MiningConfig, ModelConfig
@@ -506,36 +517,83 @@ def _record_goldens(hb: _Heartbeat, left) -> None:
     # kandinsky2 pins its template-default 768².
     metric_shape = {"negative_prompt": "", "width": WIDTH, "height": HEIGHT,
                     "num_inference_steps": STEPS, "scheduler": SCHEDULER}
+    PROBE = "8x128x128"  # robust_video_matting file-input probe clip shape
+    # need = (post-ladder, goldens-only) min seconds left to attempt.
+    # After the ladder the anythingv3 512x512x20 executables are warm
+    # in-process (~35 s/solve); goldens-only sessions compile COLD — the
+    # persistent XLA cache does not carry remote-TPU executables across
+    # sessions (observed: a goldens-only anythingv3 compile ran ~25 min)
+    # — and a job must never start a compile it has no budget to finish:
+    # the mid-compile SIGTERM exits cleanly but wastes the whole claim.
     jobs = [
-        # (template, dtype, input-overrides, min seconds left to attempt)
-        ("anythingv3", "bfloat16", metric_shape, 420),
-        ("anythingv3", "float32", metric_shape, 360),
-        ("kandinsky2", "bfloat16", {}, 900),
-        # video family at the CPU-golden shape (cross-platform row pair)
+        # (template, dtype, input-overrides, (need_warm, need_cold))
+        ("anythingv3", "bfloat16", metric_shape, (420, 1800)),
+        ("anythingv3", "float32", metric_shape, (360, 1800)),
+        ("kandinsky2", "bfloat16", {}, (900, 900)),
+        # video family at the CPU-golden shapes (cross-platform row pairs)
         ("zeroscopev2xl", "bfloat16",
          {"negative_prompt": "", "num_frames": 2, "width": 256,
-          "height": 256, "num_inference_steps": 2}, 600),
+          "height": 256, "num_inference_steps": 2}, (600, 600)),
+        ("damo", "bfloat16",
+         {"num_frames": 2, "num_inference_steps": 2}, (400, 400)),
+        ("robust_video_matting", "bfloat16", {}, (150, 150)),
     ]
+    jobs = [(t, d, o, n[1] if only_missing else n[0])
+            for t, d, o, n in jobs]
+    if only_missing:
+        # cheap rows first: a short or flaky claim should land the small
+        # absent vectors before attempting a long video/kandinsky compile
+        jobs.sort(key=lambda j: j[3])
     for template, dtype, overrides, need in jobs:
+        resolve_file = None
+        if template == "robust_video_matting":
+            # file-input template: the shared probe-golden flow
+            # (record-golden --probe-video uses the same helper, so CPU-
+            # and TPU-recorded rows cannot drift structurally)
+            from arbius_tpu.node.factory import probe_golden_input
+
+            resolve_file, raw = probe_golden_input(PROBE)
+        else:
+            raw = {"prompt": "arbius test cat", **overrides}
+        path = os.path.join(_REPO, "goldens",
+                            f"{template}.full.{platform}.{dtype}.json")
+        if only_missing and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    existing = json.load(f).get("golden", {}).get("input")
+            except (OSError, ValueError):
+                existing = None
+            if existing == raw:
+                _note(f"golden {template}/{dtype}: exists, skipped "
+                      "(only-missing mode)")
+                continue
+            _note(f"golden {template}/{dtype}: exists but its input is "
+                  "STALE vs the current job spec — re-recording")
         if left() < need:
             _note(f"golden {template}/{dtype}: skipped ({left():.0f}s left)")
             continue
         hb.set(f"golden {template} {dtype}")
-        raw = {"prompt": "arbius test cat", **overrides}
-        mc = ModelConfig(id="0x" + "00" * 32, template=template,
-                         weights_dtype=dtype)
-        m = build_registry(MiningConfig(models=(mc,))).get(mc.id)
-        hydrated = hydrate_input(dict(raw), m.template)
-        t0 = time.perf_counter()
-        cid, _files = solve_cid(m, hydrated, 1337)
+        try:
+            mc = ModelConfig(id="0x" + "00" * 32, template=template,
+                             weights_dtype=dtype)
+            m = build_registry(MiningConfig(models=(mc,)),
+                               resolve_file=resolve_file).get(mc.id)
+            hydrated = hydrate_input(dict(raw), m.template)
+            t0 = time.perf_counter()
+            cid, _files = solve_cid(m, hydrated, 1337)
+        except Exception as e:  # fault-isolate: later jobs still run
+            _note(f"golden {template}/{dtype} FAILED: "
+                  f"{type(e).__name__}: {e}")
+            continue
+        golden = {"input": raw, "seed": 1337, "cid": cid}
+        if template == "robust_video_matting":
+            golden["probe_video"] = PROBE  # regeneration recipe IN the vector
         rec = {
             "template": template, "platform": platform, "tiny": False,
             "weights_dtype": dtype,
             "elapsed_s": round(time.perf_counter() - t0, 1),
-            "golden": {"input": raw, "seed": 1337, "cid": cid},
+            "golden": golden,
         }
-        path = os.path.join(_REPO, "goldens",
-                            f"{template}.full.{platform}.{dtype}.json")
         with open(path, "w") as f:
             json.dump(rec, f)
         _note(f"golden recorded: {path} cid={cid}")
