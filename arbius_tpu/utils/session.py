@@ -1,0 +1,51 @@
+"""Chip-session discipline helpers shared by bench.py and tools/.
+
+A remote-TPU claim must be babysat: heartbeat the current phase so a
+silent hang is visible, and force process exit if interpreter teardown
+dials a wedged tunnel (observed ~1500 s hangs AFTER the last useful
+line). A SIGKILLed chip-holding process wedges the pool grant for
+hours, so clean exit is part of the claim protocol — these helpers are
+the one definition of that discipline.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class Heartbeat:
+    """Background thread reporting the current phase every `interval` s
+    through `note` (a callable taking one string)."""
+
+    def __init__(self, stage: str, note, interval: float = 15.0):
+        self.stage = stage
+        self.phase = "start"
+        self._note = note
+        self._interval = interval
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def set(self, phase: str) -> None:
+        self.phase = phase
+        self._note(f"[{self.stage}] phase: {phase}")
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._note(f"[{self.stage}] heartbeat: phase={self.phase}")
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def arm_exit_watchdog(note, grace_s: float = 90.0) -> None:
+    """Force-exit if interpreter teardown hangs past `grace_s` (clean
+    teardown normally wins the race; a wedged tunnel does not)."""
+
+    def _fire():
+        time.sleep(grace_s)
+        note(f"teardown exceeded {grace_s:.0f}s — forcing exit")
+        os._exit(0)
+
+    threading.Thread(target=_fire, daemon=True).start()

@@ -56,7 +56,6 @@ import json
 import os
 import subprocess
 import sys
-import threading
 import time
 
 A100_SOLUTIONS_PER_HOUR_EST = 1800.0  # builder's estimate — see docstring
@@ -205,26 +204,12 @@ def _emit_backstop(note: str) -> None:
 # children: actual measurement
 # ---------------------------------------------------------------------------
 
-class _Heartbeat:
-    """Background thread printing the current phase every 15 s to stderr."""
+def _Heartbeat(stage: str):
+    """Shared claim-discipline heartbeat (arbius_tpu/utils/session.py),
+    bound to this module's stderr note stream."""
+    from arbius_tpu.utils.session import Heartbeat
 
-    def __init__(self, stage: str):
-        self.stage = stage
-        self.phase = "start"
-        self._stop = threading.Event()
-        self._t = threading.Thread(target=self._run, daemon=True)
-        self._t.start()
-
-    def set(self, phase: str) -> None:
-        self.phase = phase
-        _note(f"[{self.stage}] phase: {phase}")
-
-    def _run(self) -> None:
-        while not self._stop.wait(15.0):
-            _note(f"[{self.stage}] heartbeat: phase={self.phase}")
-
-    def stop(self) -> None:
-        self._stop.set()
+    return Heartbeat(stage, _note)
 
 
 def _emit(out_path: str, line: dict) -> None:
@@ -236,15 +221,12 @@ def _emit(out_path: str, line: dict) -> None:
 
 
 def _arm_exit_watchdog(grace_s: float = 90.0) -> None:
-    """Force-exit if interpreter teardown hangs (observed: a child's
-    teardown dialed the wedged tunnel and sat ~1500 s after its last
-    result line). Clean teardown normally wins the race."""
-    def _fire():
-        time.sleep(grace_s)
-        _note(f"teardown exceeded {grace_s:.0f}s — forcing exit")
-        os._exit(0)
+    """Shared teardown watchdog (arbius_tpu/utils/session.py) — a
+    child's teardown on a wedged tunnel sat ~1500 s after its last
+    result line; clean teardown normally wins the race."""
+    from arbius_tpu.utils.session import arm_exit_watchdog
 
-    threading.Thread(target=_fire, daemon=True).start()
+    arm_exit_watchdog(_note, grace_s)
 
 
 def _timed_solutions(pipe, params, batch: int, *, width: int, height: int,
