@@ -135,10 +135,17 @@ def main() -> int:
     else:
         _note("skipping live task (budget)")
 
+    # stage spans the node recorded for the live solve (BASELINE.md's
+    # p50 task-to-commitment metric: infer = model+encode+CID, commit =
+    # the chain txs — a single-sample p50 here, but the same counters a
+    # long-running miner exposes at /api/metrics)
+    stages = {k: round(sum(v) / len(v), 2) if v else None
+              for k, v in node.metrics.stage_seconds.items()}
     print(json.dumps({
         "smoke": "tpu_node_admission", "platform": platform,
         "boot_self_test": "passed", "boot_s": round(boot_s, 1),
         "golden_cid": vec["golden"]["cid"], **live,
+        "stage_seconds": stages,
         "elapsed_s": round(time.perf_counter() - _T0, 1),
     }), flush=True)
     hb.set("done; releasing claim via clean exit")
