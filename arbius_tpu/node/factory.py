@@ -26,26 +26,47 @@ from arbius_tpu.templates.engine import load_template
 log = logging.getLogger("arbius.factory")
 
 
+def _needs_cast(params, dtype) -> bool:
+    """Host-side dtype scan: does any floating leaf differ from `dtype`?
+    Cheap (metadata only), and avoids compiling an identity cast program
+    for correctly-stored checkpoints (the documented common case)."""
+    import jax
+    import jax.numpy as jnp
+
+    target = jnp.dtype(dtype)
+    return any(
+        jnp.issubdtype(leaf.dtype, jnp.inexact) and leaf.dtype != target
+        for leaf in jax.tree_util.tree_leaves(params))
+
+
 def _params_for(pipe, m: ModelConfig):
     dtype = "bfloat16" if m.weights_dtype == "bfloat16" else None
     if m.checkpoint:
         from arbius_tpu.utils import load_params
 
         params = load_params(m.checkpoint)
-        if dtype is not None:
-            import jax
+        import jax
 
+        if dtype is not None and _needs_cast(params, dtype):
             from arbius_tpu.utils import cast_floating
 
             # one jitted program: eager per-leaf casts would dispatch one
             # op per leaf over a remote-TPU transport (the round-2 failure
             # mode). Production checkpoints should be STORED in the pinned
-            # dtype (convert-checkpoint --dtype), making this a no-op —
-            # but when it isn't, donation lets XLA free each f32 leaf at
-            # its convert instead of holding both full trees live (the
+            # dtype (convert-checkpoint --dtype) — _needs_cast skips the
+            # program entirely then (an identity cast program emits a
+            # 'donated buffer was not usable' warning per boot) — but when
+            # it isn't, donation lets XLA free each f32 leaf at its
+            # convert instead of holding both full trees live (the
             # 16 GB-chip OOM the random-init path fixes via with_cast)
             params = jax.jit(lambda p: cast_floating(p, dtype),
                              donate_argnums=0)(params)
+        else:
+            # loaded leaves are host numpy arrays; commit them to the
+            # device ONCE here (the cast program used to do this as a
+            # side effect) — otherwise every solve re-uploads the full
+            # weight tree through the jitted bucket call
+            params = jax.device_put(params)
         return params
     log.warning("model %s: no checkpoint configured, using random init",
                 m.id)

@@ -39,13 +39,17 @@ class Heartbeat:
         self._stop.set()
 
 
-def arm_exit_watchdog(note, grace_s: float = 90.0) -> None:
+def arm_exit_watchdog(note, grace_s: float = 90.0, code: int = 0) -> None:
     """Force-exit if interpreter teardown hangs past `grace_s` (clean
-    teardown normally wins the race; a wedged tunnel does not)."""
+    teardown normally wins the race; a wedged tunnel does not).
+
+    `code` is the forced exit status: callers arming from a FAILURE path
+    must pass non-zero, or a hung teardown would convert the failure into
+    rc 0 and an exit-code-gating driver would read it as success."""
 
     def _fire():
         time.sleep(grace_s)
-        note(f"teardown exceeded {grace_s:.0f}s — forcing exit")
-        os._exit(0)
+        note(f"teardown exceeded {grace_s:.0f}s — forcing exit (rc={code})")
+        os._exit(code)
 
     threading.Thread(target=_fire, daemon=True).start()

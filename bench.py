@@ -74,6 +74,11 @@ BASELINE_NOTE = ("anchor 1800 sol/h/A100 is this repo's estimate; "
 # 600 s fallback). A healthy session that is emitting lines keeps the
 # full budget.
 SESSION_TIMEOUT_S = int(os.environ.get("BENCH_SESSION_TIMEOUT_S", "3300"))
+# outer window the retry loop may span (driver bench slots are ~60-70
+# min); all claim attempts + the CPU fallback must fit inside it. The
+# default leaves the first attempt its full SESSION_TIMEOUT_S after the
+# fallback reserve (3300 + 600 + 120).
+OUTER_BUDGET_S = int(os.environ.get("BENCH_OUTER_BUDGET_S", "4020"))
 SESSION_NOLINE_ABORT_S = int(os.environ.get("BENCH_SESSION_NOLINE_ABORT_S",
                                             "1800"))
 SESSION_MARGIN_S = int(os.environ.get("BENCH_SESSION_MARGIN_S", "150"))
@@ -93,9 +98,12 @@ def _note(msg: str) -> None:
 # ---------------------------------------------------------------------------
 
 def _stream_stage(stage: str, timeout_s: int, extra_env: dict | None = None,
-                  noline_timeout_s: int | None = None) -> int:
+                  noline_timeout_s: int | None = None) -> tuple[int, int]:
     """Run a stage child; stream each completed JSON line from its scratch
-    file to stdout as it appears. Returns the number of lines emitted.
+    file to stdout as it appears. Returns (lines emitted, perf lines
+    emitted) — a perf line carries vs_baseline > 0; the tiny sanity row
+    does not, and a session that died after only the sanity row must
+    still count as having NO measurement (retry-loop gate).
 
     `noline_timeout_s`: kill the child early if it has produced ZERO
     result lines by then — a claim that hangs past the axon client's own
@@ -117,20 +125,25 @@ def _stream_stage(stage: str, timeout_s: int, extra_env: dict | None = None,
         stdout=subprocess.DEVNULL, stderr=None, env=env)  # stderr passes through
     deadline = time.perf_counter() + timeout_s
     emitted = 0
+    perf = 0
 
     def drain() -> int:
-        nonlocal emitted
+        nonlocal emitted, perf
         if not os.path.exists(out_path):
             return emitted
         with open(out_path) as f:
             lines = [ln for ln in f.read().splitlines() if ln.strip()]
         for ln in lines[emitted:]:
             try:
-                json.loads(ln)
+                parsed = json.loads(ln)
             except ValueError:
                 continue  # partially-written line; next drain gets it
             print(ln, flush=True)
             emitted += 1
+            if isinstance(parsed, dict) and isinstance(
+                    parsed.get("vs_baseline"), (int, float)) \
+                    and parsed["vs_baseline"] > 0:
+                perf += 1
         return emitted
 
     start = time.perf_counter()
@@ -162,74 +175,126 @@ def _stream_stage(stage: str, timeout_s: int, extra_env: dict | None = None,
     else:
         _note(f"stage {stage}: exited rc={child.returncode}")
     drain()
-    return emitted
+    return emitted, perf
 
 
 def main() -> None:
     total = 0
+    perf = 0
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         _note("JAX_PLATFORMS=cpu set — deliberate CPU run")
         total += _stream_stage(
-            "tiny", TINY_CPU_TIMEOUT_S, {"BENCH_FALLBACK_NOTE": "cpu_forced"})
+            "tiny", TINY_CPU_TIMEOUT_S,
+            {"BENCH_FALLBACK_NOTE": "cpu_forced"})[0]
     else:
         # A stale exported BENCH_FALLBACK_NOTE would silently force the
         # tiny child onto CPU despite a healthy TPU.
         os.environ.pop("BENCH_FALLBACK_NOTE", None)
-        total += _stream_stage(
-            "session", SESSION_TIMEOUT_S,
-            {"BENCH_SESSION_BUDGET_S": str(SESSION_TIMEOUT_S)},
-            noline_timeout_s=SESSION_NOLINE_ABORT_S)
+        # Claim-RETRY loop spanning the whole outer window (VERDICT r4
+        # ask #4): a wedged pool expires claims silently at ~1500 s but
+        # can recover within the hour, so one dead claim must not forfeit
+        # the window. Keep attempting fresh sessions until one lands a
+        # MEASUREMENT (a vs_baseline>0 line — the tiny sanity row alone
+        # means the chip died before measuring), while enough outer
+        # budget remains, reserving room for the guaranteed CPU fallback.
+        # Goldens-only sessions measure nothing by design: any line
+        # counts as success there.
+        goldens_only = os.environ.get("BENCH_GOLDENS_ONLY", "0") == "1"
+        reserve = TINY_CPU_TIMEOUT_S + 120
+        attempt = 0
+        while attempt < 6:  # cap: a fast-crashing child must not hammer
+            # the claim service for the whole window
+            succeeded = (total > 0) if goldens_only else (perf > 0)
+            if succeeded:
+                break
+            left = OUTER_BUDGET_S - (time.perf_counter() - _T0) - reserve
+            if attempt > 0:
+                left -= 60  # the backoff below spends reserve-bound time
+                if left < 420:
+                    _note(f"no further claim attempts: {left:.0f}s outer "
+                          "budget left after backoff + fallback reserve")
+                    break
+                _note("backing off 60s before the next claim attempt")
+                time.sleep(60)
+            attempt += 1
+            # every attempt (including the first — BENCH_OUTER_BUDGET_S
+            # must bound it too) fits inside the remaining outer budget;
+            # the default OUTER leaves attempt 1 its full session budget
+            stage_budget = int(min(SESSION_TIMEOUT_S, max(left, 420)))
+            _note(f"claim attempt {attempt} (stage budget {stage_budget}s)")
+            n, p = _stream_stage(
+                "session", stage_budget,
+                {"BENCH_SESSION_BUDGET_S": str(stage_budget)},
+                noline_timeout_s=min(SESSION_NOLINE_ABORT_S, stage_budget))
+            total += n
+            perf += p
         if total == 0:
             _note("TPU session produced nothing — no chip; "
                   "running guaranteed CPU-fallback line")
-            fallback = _stream_stage(
+            total += _stream_stage(
                 "tiny", TINY_CPU_TIMEOUT_S,
-                {"BENCH_FALLBACK_NOTE": "tpu_unreachable_cpu_fallback"})
-            total += fallback
-            # the chip pool wedges for hours at a time (it served this
-            # repo's committed measurement sessions earlier); if evidence
-            # from a measured session exists, REPLAY its headline — loudly
-            # labeled, with provenance — so a wedged pool at bench time
-            # reports this round's measured number instead of 0. Only
-            # when the CPU fallback itself succeeded: a run where even
-            # that failed must surface the backstop failure line, not a
-            # stale success.
-            if fallback > 0:
-                total += _replay_session_headline()
+                {"BENCH_FALLBACK_NOTE": "tpu_unreachable_cpu_fallback"})[0]
+        # the chip pool wedges for hours at a time (it served this
+        # repo's committed measurement sessions earlier); if NO live
+        # measurement landed but evidence from a measured session exists,
+        # REPLAY its headline — loudly labeled, with provenance — so a
+        # wedged pool at bench time reports this round's measured number
+        # instead of 0 or a sanity-only row. Only when at least one live
+        # line (sanity or fallback) succeeded: a run where even that
+        # failed must surface the backstop failure line, not a stale
+        # success.
+        if not goldens_only and perf == 0 and total > 0:
+            total += _replay_session_headline()
     if total == 0:
         _emit_backstop("all_stages_failed")
     _note(f"done: {total} result line(s)")
 
 
 def _replay_session_headline() -> int:
-    """Emit the best committed bench_runs/ headline as a clearly labeled
-    replay (unit is REPLAY-prefixed so no consumer can mistake it for a
-    live measurement). Selection is by highest measured value with
-    filename tiebreak — deterministic on any checkout (file mtimes are
-    not git-preserved). Returns the number of lines printed (0 or 1)."""
+    """Emit the NEWEST committed bench_runs/ session's best headline as a
+    clearly labeled replay (`"replay": true` machine-readable flag + a
+    REPLAY-prefixed unit, so no consumer can mistake it for a live
+    measurement). Selection: the best headline among the NEWEST ROUND's
+    session files (filenames embed rNN — stable on any checkout; mtimes
+    are not git-preserved) rather than the global max value: replaying an
+    older round's higher number would mask a genuine regression in the
+    newest round's evidence (ADVICE r4). Returns the number of lines
+    printed (0 or 1)."""
     import glob
+    import re
 
-    best = None  # ((value, name), line)
-    for path in sorted(glob.glob(os.path.join(_REPO, "bench_runs", "*.jsonl"))):
+    def _headlines(path):
         try:
             with open(path) as f:
                 lines = [json.loads(ln) for ln in f if ln.strip()]
-            name = os.path.basename(path)
-            for line in lines:
-                if (line.get("stage") == "headline"
-                        and isinstance(line.get("vs_baseline"), (int, float))
-                        and line["vs_baseline"] > 0
-                        and isinstance(line.get("value"), (int, float))):
-                    key = (line["value"], name)
-                    if best is None or key > best[0]:
-                        best = (key, name, line)
-        except (OSError, ValueError, TypeError):
-            continue
+        except (OSError, ValueError):
+            return []
+        return [ln for ln in lines
+                if isinstance(ln, dict)
+                and ln.get("stage") == "headline"
+                and not ln.get("replay")
+                and isinstance(ln.get("vs_baseline"), (int, float))
+                and ln["vs_baseline"] > 0
+                and isinstance(ln.get("value"), (int, float))]
+
+    def _round_of(path) -> int:
+        m = re.match(r"r(\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    best = name = None
+    paths = glob.glob(os.path.join(_REPO, "bench_runs", "*.jsonl"))
+    rounds = sorted({_round_of(p) for p in paths}, reverse=True)
+    for rnd in rounds:  # newest round that has any headline wins
+        cands = [(ln, os.path.basename(p)) for p in sorted(paths)
+                 if _round_of(p) == rnd for ln in _headlines(p)]
+        if cands:
+            best, name = max(cands, key=lambda c: c[0]["value"])
+            break
     if best is None:
         return 0
-    _, name, line = best
-    line = dict(line)
+    line = dict(best)
     line["stage"] = "replay"
+    line["replay"] = True
     line["unit"] = f"REPLAY of bench_runs/{name} — {line.get('unit', '')}"
     line["note"] = ("TPU POOL UNREACHABLE AT BENCH TIME — this is a REPLAY "
                     "of the measured headline from this round's committed "
@@ -270,13 +335,14 @@ def _emit(out_path: str, line: dict) -> None:
     _note(f"result: {json.dumps(line)}")
 
 
-def _arm_exit_watchdog(grace_s: float = 90.0) -> None:
+def _arm_exit_watchdog(grace_s: float = 90.0, code: int = 0) -> None:
     """Shared teardown watchdog (arbius_tpu/utils/session.py) — a
     child's teardown on a wedged tunnel sat ~1500 s after its last
-    result line; clean teardown normally wins the race."""
+    result line; clean teardown normally wins the race. `code` is the
+    forced exit status (non-zero on failure paths)."""
     from arbius_tpu.utils.session import arm_exit_watchdog
 
-    arm_exit_watchdog(_note, grace_s)
+    arm_exit_watchdog(_note, grace_s, code=code)
 
 
 def _timed_solutions(pipe, params, batch: int, *, width: int, height: int,
@@ -370,7 +436,12 @@ def _prod_line(val: float, unit: str, note: str, stage: str,
 
 
 def _stage_session(out_path: str) -> None:
-    """The whole TPU ladder against ONE chip claim (see module docstring)."""
+    """The whole TPU ladder against ONE chip claim (see module docstring).
+
+    Heartbeat stop + teardown watchdog are armed on EVERY exit path: an
+    OOM or tunnel error mid-ladder propagating with the heartbeat alive
+    and no watchdog can hang ~1500 s in teardown holding the claim (the
+    round-3 postmortem) — the same fix the smoke tool carries."""
     import signal
 
     # the parent's backstop is SIGTERM-then-grace; convert it to a normal
@@ -385,6 +456,19 @@ def _stage_session(out_path: str) -> None:
 
     hb = _Heartbeat("session")
     hb.set(f"claiming chip (budget {budget}s, margin {SESSION_MARGIN_S}s)")
+    try:
+        _session_body(out_path, hb, left)
+    finally:
+        hb.stop()
+        exc = sys.exc_info()[1]
+        failing = exc is not None and not (
+            isinstance(exc, SystemExit) and not exc.code)
+        _note("releasing claim via "
+              + ("FAILURE exit" if failing else "clean exit"))
+        _arm_exit_watchdog(90.0, code=1 if failing else 0)
+
+
+def _session_body(out_path: str, hb: _Heartbeat, left) -> None:
     devs = _child_common(cpu=False)
     platform = devs[0].platform
     if platform == "cpu":
@@ -503,14 +587,34 @@ def _stage_session(out_path: str) -> None:
                 f"on real TPU)",
                 "stage_batch_sweep", f"sweep_b{b}"))
 
-    # -- headline: best number LAST among result lines (driver records the
-    # last line) — emitted BEFORE the goldens stage on purpose: goldens
-    # emit no result lines, and an overrun there must not cost the labeled
-    # best number
-    if best is not None:
-        track(_prod_line(
-            best[0], best[1], _headline_note(best[2]), "headline",
-            {"batch_sweep": sweep} if sweep else None))
+    # -- headline: the best number must survive any later-stage overrun,
+    # so it is emitted HERE, immediately after the ladder — and RE-emitted
+    # after the family stages below so the driver's last-line read still
+    # sees it (family stages emit their own result lines; a SIGTERM mid-
+    # family leaves this first copy as the last line — either way the
+    # session's final line is the labeled best)
+    def _emit_headline() -> None:
+        if best is not None:
+            track(_prod_line(
+                best[0], best[1], _headline_note(best[2]), "headline",
+                {"batch_sweep": sweep} if sweep else None))
+
+    _emit_headline()
+
+    # -- other model families: kandinsky2 + zeroscope throughput rows
+    # (VERDICT r4 asks #2/#3). Cold compiles are expensive, so these only
+    # run when a long session budget remains (manual long sessions; the
+    # driver's ~55-min window normally skips them — the committed session
+    # JSONL is their evidence). Their anchors differ from the anythingv3
+    # metric, so they are emitted as their own metric names with
+    # vs_baseline 0 and never compete for the headline.
+    if os.environ.get("BENCH_FAMILIES", "auto") != "0" \
+            and not goldens_only and left() > 1200:
+        try:
+            _family_stages(hb, left, lambda l: _emit(out_path, l), platform)
+        except Exception as e:  # family rows are additive — never fail bench
+            _note(f"family stages failed: {type(e).__name__}: {e}")
+        _emit_headline()  # re-emit so the best number is the LAST line
 
     # -- goldens: admission vectors on this chip, while we hold it --------
     if left() > 120 and os.environ.get("BENCH_RECORD_GOLDENS", "1") != "0":
@@ -518,9 +622,111 @@ def _stage_session(out_path: str) -> None:
             _record_goldens(hb, left, only_missing=goldens_only)
         except Exception as e:  # goldens are a bonus — never fail the bench
             _note(f"golden recording failed: {type(e).__name__}: {e}")
-    hb.stop()
-    _note("session complete; releasing claim via clean exit")
-    _arm_exit_watchdog(90.0)
+    _note("session complete")
+
+
+def _family_stages(hb: _Heartbeat, left, emit, platform: str) -> None:
+    """Throughput rows for the non-SD families (VERDICT r4: only
+    anythingv3 had a number). Each row is an END-TO-END solve rate —
+    inference + codec + CID through the node's solver path — at a
+    declared shape, measured after a warmup solve (compile excluded, as
+    in the SD ladder). kandinsky2 runs its template default (768²×50,
+    the reference's only enabled model — miner/src/index.ts:844-877);
+    zeroscope first PROBES the template-default production shape
+    (1024×576×24f×50 — never executed anywhere before r5) and falls back
+    to a declared reduced shape if the 16 GB chip can't fit it, emitting
+    the fit result either way."""
+    from arbius_tpu.node.config import MiningConfig, ModelConfig
+    from arbius_tpu.node.factory import build_registry
+    from arbius_tpu.node.solver import solve_cid_batch
+    from arbius_tpu.templates.engine import hydrate_input
+
+    def series(template: str, raw: dict, batch: int, need_s: int,
+               shape_desc: str, rounds: int = 1) -> bool:
+        """Returns True iff a row was emitted (False = budget skip)."""
+        if left() < need_s:
+            _note(f"family {template}: skipped ({left():.0f}s < {need_s}s)")
+            return False
+        hb.set(f"family {template} {shape_desc} (compile+warmup)")
+        mc = ModelConfig(id="0x" + "00" * 32, template=template,
+                         weights_dtype="bfloat16")
+        m = build_registry(MiningConfig(models=(mc,))).get(mc.id)
+        hyd = hydrate_input(dict(raw), m.template)
+        items = [(hyd, 1000 + i) for i in range(batch)]
+        t0 = time.perf_counter()
+        solve_cid_batch(m, items, canonical_batch=batch)
+        warm_s = time.perf_counter() - t0
+        _note(f"family {template}: warmup (incl compile) {warm_s:.0f}s")
+        if left() < rounds * warm_s * 1.2 + 60:
+            # the warmup still proves the shape EXECUTES on this chip
+            # (the zeroscope prod-shape fit question) — record that even
+            # when there's no budget for a clean post-compile timing
+            emit({
+                "metric": f"{template}_warmup_only",
+                "value": round(warm_s, 1),
+                "unit": (f"seconds for first solve INCLUDING compile "
+                         f"({template} {shape_desc}, canonical_batch="
+                         f"{batch}, bf16, platform={platform}) — shape "
+                         "fits+executes; no post-compile timing budget"),
+                "vs_baseline": 0.0,
+                "note": "family_warmup_only",
+                "stage": f"family_{template}_warmup",
+                "elapsed_s": round(time.perf_counter() - _T0, 1),
+            })
+            return True
+        hb.set(f"family {template} {shape_desc} (timing)")
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            solve_cid_batch(m, [(h, 2000 + r * batch + i)
+                                for i, (h, _) in enumerate(items)],
+                            canonical_batch=batch)
+        sec = (time.perf_counter() - t0) / (rounds * batch)
+        emit({
+            "metric": f"{template}_solutions_per_hour_per_chip",
+            "value": round(3600.0 / sec, 2),
+            "unit": (f"solutions/hour/chip ({template} {shape_desc}, "
+                     f"canonical_batch={batch}, bf16, end-to-end "
+                     f"solve+codec+CID, platform={platform})"),
+            "vs_baseline": 0.0,
+            "note": "family_throughput (no cross-family anchor)",
+            "stage": f"family_{template}_b{batch}",
+            "elapsed_s": round(time.perf_counter() - _T0, 1),
+        })
+        return True
+
+    # kandinsky2 template default (768², 50 prior+decoder steps) —
+    # isolated so a kandinsky failure (e.g. OOM) can't forfeit zeroscope
+    try:
+        series("kandinsky2", {"prompt": "arbius bench task"}, 2, 2100,
+               "768x768 template-default steps")
+    except Exception as e:
+        _note(f"family kandinsky2 FAILED: {type(e).__name__}: {e}")
+
+    # zeroscope: template-default production shape fit probe, then row
+    prod = {"prompt": "arbius bench task", "negative_prompt": "",
+            "width": 1024, "height": 576, "num_frames": 24,
+            "num_inference_steps": 50}
+    ran = False
+    try:
+        ran = series("zeroscopev2xl", prod, 1, 2100,
+                     "1024x576x24f prod-default")
+    except Exception as e:
+        emit({
+            "metric": "zeroscopev2xl_prod_shape_fit",
+            "value": 0.0,
+            "unit": "prod-default 1024x576x24f x50 did NOT fit/complete",
+            "vs_baseline": 0.0,
+            "note": f"{type(e).__name__}: {e}"[:300],
+            "stage": "family_zeroscope_prod_probe",
+            "elapsed_s": round(time.perf_counter() - _T0, 1),
+        })
+    if not ran:
+        # declared reduced shape: same step count, half spatial — reached
+        # both when the prod probe FAILED (OOM) and when it was budget-
+        # skipped (the cheaper shape may still fit the remaining budget)
+        series("zeroscopev2xl",
+               {**prod, "width": 576, "height": 320}, 1, 1200,
+               "576x320x24f reduced (prod probe failed or skipped)")
 
 
 def _record_goldens(hb: _Heartbeat, left, only_missing: bool = False) -> None:

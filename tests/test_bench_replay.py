@@ -49,3 +49,23 @@ def test_replay_emits_nothing_without_evidence(tmp_path, capsys):
     b._REPO = str(tmp_path)  # no bench_runs dir at all
     assert b._replay_session_headline() == 0
     assert capsys.readouterr().out == ""
+
+
+def test_replay_prefers_newest_round_over_higher_old_value(tmp_path, capsys):
+    """ADVICE r4: an older round's higher number must not mask a genuine
+    regression in the newest round's evidence; the replayed line must be
+    machine-readably flagged."""
+    b = _load_bench()
+    runs = tmp_path / "bench_runs"
+    runs.mkdir()
+    (runs / "r04_tpu_session_x.jsonl").write_text(
+        json.dumps(_headline(9999.0)) + "\n")
+    (runs / "r05_tpu_session_x.jsonl").write_text(
+        json.dumps(_headline(3600.0)) + "\n"
+        + json.dumps(_headline(3500.0)) + "\n")
+    b._REPO = str(tmp_path)
+    assert b._replay_session_headline() == 1
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] == 3600.0  # best WITHIN the newest round only
+    assert line["replay"] is True
+    assert line["unit"].startswith("REPLAY of bench_runs/r05_tpu_session_x")

@@ -68,6 +68,28 @@ def main() -> int:
               "aborting (exit 4)")
         os._exit(4)
 
+    # a BootError / solve failure after the claim must release it just as
+    # promptly as success: stop the heartbeat and arm the teardown
+    # watchdog on EVERY exit path (an exception propagating with the
+    # heartbeat live can hang ~1500 s on a wedged tunnel, holding the
+    # claim — the exact pool-wedging these helpers exist to prevent)
+    try:
+        return _post_claim(hb, vec, platform)
+    finally:
+        hb.set("releasing claim via clean exit")
+        hb.stop()
+        # on the exception path the watchdog must force a FAILURE code —
+        # os._exit(0) after a BootError would report a failed admission
+        # smoke as success to any exit-code-gating driver. SystemExit
+        # with a 0/None code is NOT a failure: it's the SIGTERM handler's
+        # designed clean claim release.
+        exc = sys.exc_info()[1]
+        failing = exc is not None and not (
+            isinstance(exc, SystemExit) and not exc.code)
+        arm_exit_watchdog(_note, 90.0, code=1 if failing else 0)
+
+
+def _post_claim(hb, vec, platform: str) -> int:
     from arbius_tpu.chain import WAD, Engine, TokenLedger
     from arbius_tpu.node import LocalChain, MinerNode
     from arbius_tpu.node.config import MiningConfig, ModelConfig
@@ -148,8 +170,6 @@ def main() -> int:
         "stage_seconds": stages,
         "elapsed_s": round(time.perf_counter() - _T0, 1),
     }), flush=True)
-    hb.set("done; releasing claim via clean exit")
-    arm_exit_watchdog(_note, 90.0)
     return 0
 
 
