@@ -9,10 +9,16 @@ produces identical bytes, hence identical CIDs.
 from arbius_tpu.codecs.deflate import compress as deflate_compress
 from arbius_tpu.codecs.deflate import deflate_fixed, zlib_compress
 from arbius_tpu.codecs.jpeg import encode_jpeg
-from arbius_tpu.codecs.mp4 import encode_mp4, mux_mjpeg_mp4
+from arbius_tpu.codecs.mp4 import (
+    encode_mp4,
+    encode_mp4_h264,
+    mux_avc1_mp4,
+    mux_mjpeg_mp4,
+)
 from arbius_tpu.codecs.png import encode_png
 
 __all__ = [
     "deflate_compress", "deflate_fixed", "zlib_compress",
-    "encode_jpeg", "encode_mp4", "mux_mjpeg_mp4", "encode_png",
+    "encode_jpeg", "encode_mp4", "encode_mp4_h264", "mux_avc1_mp4",
+    "mux_mjpeg_mp4", "encode_png",
 ]

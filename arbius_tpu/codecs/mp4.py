@@ -62,28 +62,55 @@ def _hdlr() -> bytes:
     return _full(b"hdlr", 0, 0, p)
 
 
-def _stsd(width: int, height: int) -> bytes:
+def _visual_entry(tag: bytes, width: int, height: int, name: bytes,
+                  extra: bytes = b"") -> bytes:
+    """VisualSampleEntry (78 fixed bytes) + child boxes (`extra`)."""
     entry = b"\x00" * 6 + struct.pack(">H", 1)              # reserved, dref 1
     entry += struct.pack(">HHIII", 0, 0, 0, 0, 0)           # pre-defined
     entry += struct.pack(">HH", width, height)
     entry += struct.pack(">II", 0x480000, 0x480000)         # 72 dpi
     entry += struct.pack(">IH", 0, 1)                       # frame count 1
-    name = b"arbius mjpeg"
     entry += bytes([len(name)]) + name + b"\x00" * (31 - len(name))
     entry += struct.pack(">Hh", 24, -1)                     # depth, color table
-    sample_entry = _box(b"jpeg", entry)
+    return _box(tag, entry + extra)
+
+
+def _stsd(sample_entry: bytes) -> bytes:
     return _full(b"stsd", 0, 0, struct.pack(">I", 1) + sample_entry)
 
 
 def mux_mjpeg_mp4(jpeg_frames: list[bytes], fps: int,
                   width: int, height: int) -> bytes:
-    n = len(jpeg_frames)
+    return _mux_video(jpeg_frames, fps,
+                      _visual_entry(b"jpeg", width, height, b"arbius mjpeg"),
+                      width, height)
+
+
+def mux_avc1_mp4(access_units: list[bytes], sps: bytes, pps: bytes,
+                 fps: int, width: int, height: int) -> bytes:
+    """H.264-in-MP4: each sample is one length-prefixed IDR NAL; SPS/PPS
+    travel out-of-band in the avcC record (standard avc1 storage). Every
+    sample is a sync sample (all-IDR), so no stss box is needed — its
+    absence declares exactly that."""
+    from arbius_tpu.codecs.h264 import avcc_box_payload
+
+    samples = [struct.pack(">I", len(au)) + au for au in access_units]
+    # avcC carries complete NAL units (header byte + escaped payload),
+    # which is exactly what h264.sps_bytes/pps_bytes return
+    avcc = _box(b"avcC", avcc_box_payload(sps, pps))
+    entry = _visual_entry(b"avc1", width, height, b"arbius avc", avcc)
+    return _mux_video(samples, fps, entry, width, height)
+
+
+def _mux_video(samples: list[bytes], fps: int, sample_entry: bytes,
+               width: int, height: int) -> bytes:
+    n = len(samples)
     if n == 0:
         raise ValueError("need at least one frame")
     timescale = fps
     duration = n
 
-    mdat_payload = b"".join(jpeg_frames)
+    mdat_payload = b"".join(samples)
     ftyp = _box(b"ftyp", b"isom" + struct.pack(">I", 0x200) + b"isomiso2mp41")
     mdat = _box(b"mdat", mdat_payload)
 
@@ -91,17 +118,17 @@ def mux_mjpeg_mp4(jpeg_frames: list[bytes], fps: int,
     data_start = len(ftyp) + 8
     offsets = []
     off = data_start
-    for f in jpeg_frames:
+    for f in samples:
         offsets.append(off)
         off += len(f)
 
     stts = _full(b"stts", 0, 0, struct.pack(">III", 1, n, 1))
     stsc = _full(b"stsc", 0, 0, struct.pack(">IIII", 1, 1, 1, 1))
     stsz = _full(b"stsz", 0, 0, struct.pack(">II", 0, n)
-                 + b"".join(struct.pack(">I", len(f)) for f in jpeg_frames))
+                 + b"".join(struct.pack(">I", len(f)) for f in samples))
     stco = _full(b"stco", 0, 0, struct.pack(">I", n)
                  + b"".join(struct.pack(">I", o) for o in offsets))
-    stbl = _box(b"stbl", _stsd(width, height) + stts + stsc + stsz + stco)
+    stbl = _box(b"stbl", _stsd(sample_entry) + stts + stsc + stsz + stco)
 
     dref = _full(b"dref", 0, 0, struct.pack(">I", 1) + _full(b"url ", 0, 1, b""))
     dinf = _box(b"dinf", dref)
@@ -121,3 +148,14 @@ def encode_mp4(frames: np.ndarray, fps: int = 8, quality: int = 90) -> bytes:
     t, h, w, _ = frames.shape
     jpegs = [encode_jpeg(frames[i], quality=quality) for i in range(t)]
     return mux_mjpeg_mp4(jpegs, fps=fps, width=w, height=h)
+
+
+def encode_mp4_h264(frames: np.ndarray, fps: int = 8) -> bytes:
+    """uint8 [T,H,W,3] RGB -> deterministic H.264 (all-intra I_PCM,
+    lossless-in-YCbCr) MP4 bytes — the browser-playable artifact class
+    the reference's cog/ffmpeg outputs belong to (codecs/h264.py)."""
+    from arbius_tpu.codecs.h264 import encode_h264
+
+    t, h, w, _ = frames.shape
+    sps, pps, aus = encode_h264(frames)
+    return mux_avc1_mp4(aus, sps, pps, fps=fps, width=w, height=h)

@@ -45,8 +45,11 @@ def _find(data: bytes, path: list[bytes], start=0, end=None):
     raise ValueError(f"box {path[0]!r} not found")
 
 
-def demux_mjpeg_mp4(data: bytes) -> list[bytes]:
-    """Extract per-sample JPEG bytes from an MJPEG MP4."""
+def demux_samples(data: bytes) -> list[bytes]:
+    """Walk the full sample tables (stsz/stco/co64/stsc incl. run
+    expansion) of the first video track → per-sample bytes. Shared by the
+    MJPEG and H.264 demux paths — an external muxer may pack many samples
+    per chunk, which a naive zip(stco, stsz) silently truncates."""
     stbl = _find(data, [b"moov", b"trak", b"mdia", b"minf", b"stbl"])
     sizes = chunk_offsets = stsc = None
     for tag, s, e in _boxes(data, *stbl):
@@ -96,12 +99,15 @@ def demux_mjpeg_mp4(data: bytes) -> list[bytes]:
         raise ValueError(
             f"sample tables inconsistent: stsc/stco cover {si} samples, "
             f"stsz declares {len(sizes)}")
-    samples = []
-    for off, sz in zip(offsets, sizes):
-        blob = data[off:off + sz]
+    return [data[off:off + sz] for off, sz in zip(offsets, sizes)]
+
+
+def demux_mjpeg_mp4(data: bytes) -> list[bytes]:
+    """Extract per-sample JPEG bytes from an MJPEG MP4."""
+    samples = demux_samples(data)
+    for i, blob in enumerate(samples):
         if blob[:2] != b"\xff\xd8":
-            raise ValueError(f"sample at {off} is not a JPEG (MJPEG only)")
-        samples.append(blob)
+            raise ValueError(f"sample {i} is not a JPEG (MJPEG only)")
     return samples
 
 
@@ -114,3 +120,27 @@ def decode_mjpeg_mp4(data: bytes) -> np.ndarray:
     if not frames:
         raise ValueError("no frames")
     return np.stack(frames)
+
+
+def decode_video_mp4(data: bytes) -> np.ndarray:
+    """MP4 bytes → uint8 [T, H, W, 3] RGB, dispatching on the sample
+    entry: `avc1` (the framework's H.264 I_PCM class, codecs/h264.py)
+    or MJPEG. The input side of the video-matting path."""
+    try:
+        stsd_s, stsd_e = _find(data, [b"moov", b"trak", b"mdia", b"minf",
+                                      b"stbl", b"stsd"])
+    except ValueError:
+        raise ValueError("not an ISO BMFF video file (no stsd)")
+    entry_tags = [tag for tag, _, _ in _boxes(data, stsd_s + 8, stsd_e)]
+    if b"avc1" in entry_tags:
+        from arbius_tpu.codecs.h264_decode import (
+            decode_h264_mp4_yuv,
+            yuv420_to_rgb,
+        )
+
+        frames = [yuv420_to_rgb(y, cb, cr)
+                  for y, cb, cr in decode_h264_mp4_yuv(data)]
+        if not frames:
+            raise ValueError("no frames")
+        return np.stack(frames)
+    return decode_mjpeg_mp4(data)

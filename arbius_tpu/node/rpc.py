@@ -66,6 +66,8 @@ class ControlRPC:
                         "data": j.data} for j in jobs])
                 elif self.path == "/api/metrics":
                     self._send(200, outer.metrics())
+                elif self.path == "/api/chain/info":
+                    self._send(200, outer.chain_info())
                 elif self.path.startswith("/ipfs/"):
                     outer.serve_ipfs(self)
                 else:
@@ -190,6 +192,27 @@ class ControlRPC:
         taskid = self.node.chain.submit_task(0, self.node.chain.address,
                                              model_id, fee, input_bytes)
         return {"taskid": taskid or None, "submitted": True}
+
+    def chain_info(self) -> dict:
+        """What an EIP-1193 browser wallet needs to build a submitTask tx
+        itself (generate.tsx's wagmi flow without a JS toolchain): the
+        engine address and the function selector. The wallet signs AND
+        sends through its own provider — the node never sees the key."""
+        from arbius_tpu.chain.rpc_client import ENGINE_FNS, selector
+
+        sig, _ = ENGINE_FNS["submitTask"]
+        chain = self.node.chain
+        engine = getattr(getattr(chain, "client", None), "engine_address",
+                         None)
+        if engine is None:
+            eng = getattr(chain, "engine", None)
+            engine = getattr(eng, "ADDRESS", None) if eng is not None \
+                else None
+        return {
+            "engine": engine,
+            "submit_task_signature": sig,
+            "submit_task_selector": "0x" + selector(sig).hex(),
+        }
 
     def submit_raw_tx(self, body: dict) -> dict:
         """USER-wallet task submission (the other half of generate.tsx
@@ -417,7 +440,45 @@ class ControlRPC:
             ".textContent=JSON.stringify(j)});return false\">"
             "<textarea name='raw' rows='2' "
             "placeholder='0x02… signed EIP-1559 transaction'></textarea>"
-            "<br><button>forward</button> <span id='rawres'></span></form>")
+            "<br><button>forward</button> <span id='rawres'></span></form>"
+            # EIP-1193 path: the page itself ABI-encodes submitTask and
+            # hands the tx to window.ethereum (MetaMask-class) — the
+            # wallet signs and sends through ITS provider; the node never
+            # sees the key. generate.tsx's wagmi/web3modal flow
+            # (website/src/pages/generate.tsx) without a JS toolchain.
+            "<h3>…or sign in your browser wallet (EIP-1193)</h3>"
+            "<script>async function mmSubmit(f){try{"
+            "if(!window.ethereum)throw Error('no EIP-1193 wallet "
+            "(window.ethereum) detected');"
+            "const info=await fetch('/api/chain/info').then(r=>r.json());"
+            "if(!info.engine)throw Error('node has no engine address');"
+            "const acc=(await ethereum.request({method:'eth_requestAccounts'"
+            "}))[0];"
+            "const hx=(v,n)=>BigInt(v).toString(16).padStart(n*2,'0');"
+            "const input=new TextEncoder().encode(JSON.stringify("
+            "JSON.parse(f.input.value)));"
+            "const ih=Array.from(input).map(b=>b.toString(16).padStart(2,'0'"
+            ")).join('');"
+            "const data=info.submit_task_selector"
+            "+hx(0,32)"                                    # version uint8
+            "+acc.slice(2).toLowerCase().padStart(64,'0')"  # owner
+            "+f.model.value.slice(2).padStart(64,'0')"      # model bytes32
+            "+hx(f.fee.value||'0',32)"                      # fee uint256
+            "+hx(0xa0,32)"                                  # bytes offset
+            "+hx(input.length,32)"
+            "+ih.padEnd(Math.ceil(ih.length/64)*64,'0');"
+            "const tx=await ethereum.request({method:'eth_sendTransaction',"
+            "params:[{from:acc,to:info.engine,data:data}]});"
+            "document.getElementById('mmres').textContent='tx: '+tx;"
+            "}catch(e){document.getElementById('mmres').textContent="
+            "'error: '+(e.message||e)}return false}</script>"
+            "<form onsubmit='return mmSubmit(this)'>"
+            f"<label>model <select name='model'>{options}</select></label> "
+            "<label>fee (wad) <input name='fee' value='0' size='8'></label>"
+            "<br><textarea name='input' rows='2'>"
+            '{"prompt": "arbius test cat", "negative_prompt": ""}'
+            "</textarea><br><button>sign in wallet</button> "
+            "<span id='mmres'></span></form>")
         return (
             "<!doctype html><html><head><meta charset='utf-8'>"
             "<title>arbius-tpu node</title>"

@@ -70,17 +70,27 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "pad_d"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    interpret: bool = False) -> jax.Array:
-    """Exact attention, flash-style. q/k/v: [B, H, S, D] → [B, H, Sq, D]."""
+                    interpret: bool = False, pad_d: bool = True) -> jax.Array:
+    """Exact attention, flash-style. q/k/v: [B, H, S, D] → [B, H, Sq, D].
+
+    `pad_d=False` skips the explicit head-dim pad to 128 lanes and hands
+    the native D (40/80/160 at SD-1.5 levels) straight to the kernel —
+    Mosaic lane-pads blocks in VMEM internally, so the math is identical,
+    but the jnp.pad round-trips through HBM (a 3.2× inflation of Q/K/V
+    traffic at D=40) disappear. MXU pass count is the same either way
+    (contraction/lane dims ≤128 occupy one pass regardless), so this
+    targets HBM bandwidth, not FLOPs — measured per-impl by
+    tools/tpu_profile.py before it becomes the default."""
     b, h, sq, d = q.shape
     kv_len = k.shape[2]
     scale = 1.0 / np.sqrt(d)
 
-    qf = _pad_to(_pad_to(q.reshape(b * h, sq, d), 1, BLOCK_Q), 2, 128)
-    kf = _pad_to(_pad_to(k.reshape(b * h, kv_len, d), 1, BLOCK_K), 2, 128)
-    vf = _pad_to(_pad_to(v.reshape(b * h, kv_len, d), 1, BLOCK_K), 2, 128)
+    d_mult = 128 if pad_d else 1
+    qf = _pad_to(_pad_to(q.reshape(b * h, sq, d), 1, BLOCK_Q), 2, d_mult)
+    kf = _pad_to(_pad_to(k.reshape(b * h, kv_len, d), 1, BLOCK_K), 2, d_mult)
+    vf = _pad_to(_pad_to(v.reshape(b * h, kv_len, d), 1, BLOCK_K), 2, d_mult)
     bh, sq_p, d_p = qf.shape
     kv_p = kf.shape[1]
 

@@ -211,3 +211,38 @@ def test_raw_tx_rejected_on_localchain(dapp):
         urllib.request.urlopen(req)
     assert e.value.code == 400
     assert len(eng.tasks) == 0
+
+
+def test_chain_info_and_eip1193_page_path(dapp):
+    """The browser-wallet path: /api/chain/info hands the page what it
+    needs, the served JS ABI-encodes submitTask identically to the
+    protocol encoder, and the page actually embeds the EIP-1193 flow."""
+    eng, chain, node, rpc, mid = dapp
+    info = json.loads(_get_text(rpc.port, "/api/chain/info"))
+    from arbius_tpu.chain.rpc_client import ENGINE_FNS, selector
+    sig, types = ENGINE_FNS["submitTask"]
+    assert info["submit_task_selector"] == "0x" + selector(sig).hex()
+    assert info["engine"]  # LocalChain exposes Engine.ADDRESS
+
+    # mirror the page JS's encoding in python; it must equal the
+    # protocol ABI encoder's calldata byte-for-byte
+    from arbius_tpu.chain.rpc_client import call_data
+    owner = "0x" + "42" * 20
+    fee = 123
+    input_bytes = json.dumps(task_input("via metamask")).encode()
+    expected = call_data(sig, types, [0, owner, mid, fee, input_bytes])
+    ih = input_bytes.hex()
+    js_built = (
+        info["submit_task_selector"]
+        + format(0, "064x")
+        + owner[2:].lower().rjust(64, "0")
+        + mid[2:].rjust(64, "0")
+        + format(fee, "064x")
+        + format(0xA0, "064x")
+        + format(len(input_bytes), "064x")
+        + ih.ljust((len(ih) + 63) // 64 * 64, "0"))
+    assert js_built == "0x" + expected.hex()
+
+    page = _get_text(rpc.port, "/")
+    assert "window.ethereum" in page and "eth_requestAccounts" in page
+    assert "eth_sendTransaction" in page

@@ -1,0 +1,208 @@
+"""H.264 I_PCM encoder round-trip tests.
+
+The environment ships no third-party H.264 decoder (and the determinism
+contract forbids depending on one), so validation is a from-scratch
+decoder (codecs/h264_decode.py) driven over the encoder's own output:
+I_PCM is lossless by specification, so the decode must recover the
+encoder's YCbCr samples BIT-EXACTLY, through the full mp4→avcC→NAL→
+slice→macroblock path.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from arbius_tpu.codecs import encode_mp4_h264
+from arbius_tpu.codecs.h264 import (
+    BitWriter,
+    encode_h264,
+    escape_rbsp,
+    rgb_to_yuv420,
+    sps_bytes,
+)
+from arbius_tpu.codecs.h264_decode import (
+    BitReader,
+    decode_h264_mp4_yuv,
+    parse_sps,
+    unescape_rbsp,
+    yuv420_to_rgb,
+)
+
+
+def _frames(t, h, w, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 256, (t, h, w, 3), np.uint8)
+
+
+# -- bitstream primitives -------------------------------------------------
+
+@pytest.mark.parametrize("values", [[0, 1, 2, 25, 255, 100000]])
+def test_exp_golomb_roundtrip(values):
+    w = BitWriter()
+    for v in values:
+        w.ue(v)
+    for v in [-5, 0, 3, -100, 7]:
+        w.se(v)
+    w.trailing()
+    r = BitReader(w.bytes())
+    assert [r.ue() for _ in values] == values
+    assert [r.se() for _ in range(5)] == [-5, 0, 3, -100, 7]
+
+
+def test_emulation_prevention_roundtrip():
+    # every escape-relevant pattern, incl. chained zeros
+    raw = bytes([0, 0, 0, 0, 0, 1, 0, 0, 2, 0, 0, 3, 1, 0, 0]) + b"\x00" * 8
+    esc = escape_rbsp(raw)
+    assert b"\x00\x00\x00" not in esc[:len(esc) - 2]
+    assert unescape_rbsp(esc) == raw
+
+
+def test_sps_geometry_with_cropping():
+    sps = parse_sps(unescape_rbsp(sps_bytes(1000, 568)[1:]))
+    assert (sps["width"], sps["height"]) == (1000, 568)
+    assert sps["mbs_w"] == 63 and sps["mbs_h"] == 36
+    assert sps["profile"] == 66
+
+
+# -- full round trip ------------------------------------------------------
+
+@pytest.mark.parametrize("t,h,w", [
+    (2, 32, 48),     # MB-aligned
+    (3, 40, 56),     # needs cropping (40=2.5 MBs, 56=3.5 MBs)
+    (1, 128, 128),   # RVM probe-clip shape
+])
+def test_mp4_roundtrip_lossless_yuv(t, h, w):
+    frames = _frames(t, h, w)
+    data = encode_mp4_h264(frames, fps=8)
+    decoded = decode_h264_mp4_yuv(data)
+    assert len(decoded) == t
+    for i in range(t):
+        y, cb, cr = rgb_to_yuv420(frames[i])
+        dy, dcb, dcr = decoded[i]
+        np.testing.assert_array_equal(dy, y)      # I_PCM is lossless
+        np.testing.assert_array_equal(dcb, cb)
+        np.testing.assert_array_equal(dcr, cr)
+
+
+def test_encode_deterministic():
+    frames = _frames(2, 32, 32, seed=7)
+    assert encode_mp4_h264(frames, fps=8) == encode_mp4_h264(frames, fps=8)
+
+
+def test_pcm_zero_samples_force_emulation_prevention():
+    """All-zero YCbCr payloads generate long 00 runs inside the slice;
+    the escaped NAL must still round-trip bit-exactly."""
+    from arbius_tpu.codecs.h264 import idr_slice_ipcm, pps_bytes
+    from arbius_tpu.codecs.h264_decode import (
+        decode_idr_ipcm,
+        parse_pps,
+    )
+
+    y = np.zeros((16, 16), np.uint8)
+    c = np.zeros((8, 8), np.uint8)
+    nal = idr_slice_ipcm(y, c, c, idr_pic_id=0)
+    assert b"\x00\x00\x03" in nal  # escaping actually engaged
+    sps = parse_sps(unescape_rbsp(sps_bytes(16, 16)[1:]))
+    pps = parse_pps(unescape_rbsp(pps_bytes()[1:]))
+    dy, dcb, dcr = decode_idr_ipcm(unescape_rbsp(nal[1:]), sps, pps)
+    np.testing.assert_array_equal(dy, y)
+    np.testing.assert_array_equal(dcb, c)
+    np.testing.assert_array_equal(dcr, c)
+
+
+def test_yuv_rgb_color_transform_bounds():
+    """Limited-range transform keeps Y in [16,235]-ish and survives the
+    inverse within rounding error."""
+    frames = _frames(1, 16, 16, seed=3)
+    y, cb, cr = rgb_to_yuv420(frames[0])
+    assert y.min() >= 16 and y.max() <= 235
+    rgb = yuv420_to_rgb(y, cb, cr)
+    # chroma subsampling + integer rounding: loose tolerance, right shape
+    assert rgb.shape == frames[0].shape
+    assert abs(int(rgb.astype(int).mean()) - int(frames[0].mean())) < 16
+
+
+def test_browser_relevant_structure():
+    """The avc1 boxes a <video> demuxer needs: ftyp brand, avcC with
+    inline SPS/PPS, length-prefixed IDR samples."""
+    data = encode_mp4_h264(_frames(2, 32, 32), fps=8)
+    assert data[4:8] == b"ftyp"
+    assert b"avc1" in data and b"avcC" in data
+    assert b"jpeg" not in data[-2000:]  # no MJPEG sample entry anymore
+
+
+def test_multi_sample_per_chunk_avc1_decodes_all_frames():
+    """External muxers pack many samples per chunk; the avc1 demux must
+    walk stsc run expansion, not zip(stco, stsz) (which truncates)."""
+    import struct
+
+    from arbius_tpu.codecs.h264 import encode_h264
+    from arbius_tpu.codecs.mp4 import (
+        _box,
+        _full,
+        _hdlr,
+        _mdhd,
+        _mvhd,
+        _stsd,
+        _tkhd,
+        _visual_entry,
+    )
+    from arbius_tpu.codecs.h264 import avcc_box_payload
+
+    frames = _frames(4, 32, 32, seed=9)
+    sps, pps, aus = encode_h264(frames)
+    samples = [struct.pack(">I", len(au)) + au for au in aus]
+    ftyp = _box(b"ftyp", b"isom" + struct.pack(">I", 0x200) + b"isomiso2mp41")
+    mdat = _box(b"mdat", b"".join(samples))
+    data_start = len(ftyp) + 8
+    chunk2 = data_start + len(samples[0]) + len(samples[1])
+    stts = _full(b"stts", 0, 0, struct.pack(">III", 1, 4, 1))
+    stsc = _full(b"stsc", 0, 0, struct.pack(">IIII", 1, 1, 2, 1))  # 2/chunk
+    stsz = _full(b"stsz", 0, 0, struct.pack(">II", 0, 4)
+                 + b"".join(struct.pack(">I", len(s)) for s in samples))
+    stco = _full(b"stco", 0, 0, struct.pack(">III", 2, data_start, chunk2))
+    entry = _visual_entry(b"avc1", 32, 32, b"arbius avc",
+                          _box(b"avcC", avcc_box_payload(sps, pps)))
+    stbl = _box(b"stbl", _stsd(entry) + stts + stsc + stsz + stco)
+    dref = _full(b"dref", 0, 0,
+                 struct.pack(">I", 1) + _full(b"url ", 0, 1, b""))
+    minf = _box(b"minf", _full(b"vmhd", 0, 1, struct.pack(">HHHH", 0, 0, 0,
+                                                          0))
+                + _box(b"dinf", dref) + stbl)
+    mdia = _box(b"mdia", _mdhd(4, 4) + _hdlr() + minf)
+    trak = _box(b"trak", _tkhd(4, 32, 32) + mdia)
+    moov = _box(b"moov", _mvhd(4, 4) + trak)
+    decoded = decode_h264_mp4_yuv(ftyp + mdat + moov)
+    assert len(decoded) == 4
+    for i in range(4):
+        y, _, _ = rgb_to_yuv420(frames[i])
+        np.testing.assert_array_equal(decoded[i][0], y)
+
+
+def test_slice_header_with_deblocking_enabled_parses():
+    """disable_deblocking_filter_idc != 1 carries alpha/beta offsets
+    (spec 7.3.3) — an external stream with deblocking ON (idc 0) must
+    still parse (I_PCM samples bypass the filter)."""
+    from arbius_tpu.codecs.h264 import BitWriter, escape_rbsp, pps_bytes
+    from arbius_tpu.codecs.h264_decode import decode_idr_ipcm, parse_pps
+
+    y = np.arange(256, dtype=np.uint8).reshape(16, 16)
+    c = np.full((8, 8), 77, np.uint8)
+    w = BitWriter()
+    w.ue(0); w.ue(7); w.ue(0)      # first_mb, slice_type I, pps_id
+    w.u(0, 4)                       # frame_num
+    w.ue(0)                         # idr_pic_id
+    w.u(0, 1); w.u(0, 1)            # dec_ref_pic_marking
+    w.se(0)                         # slice_qp_delta
+    w.ue(0)                         # disable_deblocking_filter_idc = 0 (ON)
+    w.se(2); w.se(-2)               # alpha/beta offsets — must be consumed
+    w.ue(25); w.align_zero()
+    w.raw(y.tobytes()); w.raw(c.tobytes()); w.raw(c.tobytes())
+    w.trailing()
+    rbsp = w.bytes()
+    sps = parse_sps(unescape_rbsp(sps_bytes(16, 16)[1:]))
+    pps = parse_pps(unescape_rbsp(pps_bytes()[1:]))
+    del escape_rbsp  # (slice parsed pre-escape here)
+    dy, dcb, dcr = decode_idr_ipcm(rbsp, sps, pps)
+    np.testing.assert_array_equal(dy, y)
+    np.testing.assert_array_equal(dcb, c)

@@ -146,7 +146,9 @@ def test_demux_multi_chunk_layout():
     import struct
 
     from arbius_tpu.codecs.jpeg import encode_jpeg
-    from arbius_tpu.codecs.mp4 import _box, _full, _stsd, _mvhd, _tkhd, _mdhd, _hdlr
+    from arbius_tpu.codecs.mp4 import (_box, _full, _stsd, _mvhd,
+                                   _tkhd, _mdhd, _hdlr,
+                                   _visual_entry)
     from arbius_tpu.codecs.mp4_demux import demux_mjpeg_mp4
 
     rng = np.random.default_rng(2)
@@ -162,7 +164,8 @@ def test_demux_multi_chunk_layout():
                  + b"".join(struct.pack(">I", len(j)) for j in jpegs))
     stco = _full(b"stco", 0, 0, struct.pack(">III", 2, data_start,
                                             chunk2_start))
-    stbl = _box(b"stbl", _stsd(16, 16) + stts + stsc + stsz + stco)
+    entry = _visual_entry(b"jpeg", 16, 16, b"arbius mjpeg")
+    stbl = _box(b"stbl", _stsd(entry) + stts + stsc + stsz + stco)
     dref = _full(b"dref", 0, 0, struct.pack(">I", 1) + _full(b"url ", 0, 1, b""))
     minf = _box(b"minf", _full(b"vmhd", 0, 1, struct.pack(">HHHH", 0, 0, 0, 0))
                 + _box(b"dinf", dref) + stbl)

@@ -130,46 +130,79 @@ def _post_claim(hb, vec, platform: str) -> int:
     _note(f"boot self-test PASSED in {boot_s:.1f}s "
           f"(golden {vec['golden']['cid'][:18]}…)")
 
-    live = {"attempted": False, "solved": False, "claimed": False,
-            "solve_s": None}
+    # live mining burst: N tasks through the full event→solve→commit→
+    # reveal→claim lifecycle, measured per task — BASELINE.md's p50/p95
+    # task-to-commitment distribution (VERDICT r4 ask #6), not a single
+    # sample. The boot self-test above already compiled the metric-shape
+    # bucket, so the burst rides a warm executable.
+    n_tasks = int(os.environ.get("SMOKE_TASKS", "20"))
+    live = {"attempted": False, "solved": 0, "claimed": 0,
+            "n_tasks": n_tasks, "solve_s": None}
+    latencies: list[float] = []
     if time.perf_counter() - _T0 < BUDGET_S - 300:
         live["attempted"] = True
-        hb.set("live task at the metric shape")
-        tid = eng.submit_task(user, 0, user, mid_b, 0, json.dumps({
-            "prompt": "arbius smoke test, a cat mining on a tpu",
-            "negative_prompt": "", "width": 512, "height": 512,
-            "num_inference_steps": 20,
-            "scheduler": "DPMSolverMultistep"}).encode())
-        _note(f"task submitted: 0x{tid.hex()}")
+        hb.set(f"live burst: {n_tasks} tasks at the metric shape")
+        t_submit: dict[bytes, float] = {}
+        for i in range(n_tasks):
+            tid = eng.submit_task(user, 0, user, mid_b, 0, json.dumps({
+                "prompt": f"arbius smoke test {i}, a cat mining on a tpu",
+                "negative_prompt": "", "width": 512, "height": 512,
+                "num_inference_steps": 20,
+                "scheduler": "DPMSolverMultistep"}).encode())
+            t_submit[tid] = time.perf_counter()
+        _note(f"{n_tasks} tasks submitted")
         t0 = time.perf_counter()
-        while node.tick():
-            pass
+        pending = set(t_submit)
+        deadline = _T0 + BUDGET_S - 240
+        while node.tick() and time.perf_counter() < deadline:
+            for tid in [t for t in pending if t in eng.solutions]:
+                # task-to-commitment wall time: burst submission →
+                # solution on chain (queue wait + infer + CID + txs)
+                latencies.append(time.perf_counter() - t_submit[tid])
+                pending.discard(tid)
+        for tid in [t for t in pending if t in eng.solutions]:
+            latencies.append(time.perf_counter() - t_submit[tid])
+            pending.discard(tid)
         live["solve_s"] = round(time.perf_counter() - t0, 1)
-        sol = eng.solutions.get(tid)
-        live["solved"] = sol is not None
-        if sol is not None:
-            _note(f"solution cid 0x{sol.cid.hex()[:16]}… "
-                  f"in {live['solve_s']}s")
+        live["solved"] = n_tasks - len(pending)
+        _note(f"{live['solved']}/{n_tasks} solved in {live['solve_s']}s")
+        if live["solved"]:
             eng.advance_time(2200)
-            while node.tick():
+            while node.tick() and time.perf_counter() < deadline + 120:
                 pass
-            live["claimed"] = node.metrics.solutions_claimed == 1
+            live["claimed"] = node.metrics.solutions_claimed
     else:
-        _note("skipping live task (budget)")
+        _note("skipping live burst (budget)")
 
-    # stage spans the node recorded for the live solve (BASELINE.md's
-    # p50 task-to-commitment metric: infer = model+encode+CID, commit =
-    # the chain txs — a single-sample p50 here, but the same counters a
-    # long-running miner exposes at /api/metrics)
-    stages = {k: round(sum(v) / len(v), 2) if v else None
-              for k, v in node.metrics.stage_seconds.items()}
-    print(json.dumps({
+    def _pct(vals, q):
+        if not vals:
+            return None
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(q * len(s)))], 2)
+
+    # per-stage spans + the task-to-commitment distribution (the
+    # counters a long-running miner exposes at /api/metrics)
+    stages = {
+        k: {"p50": _pct(list(v), 0.50), "p95": _pct(list(v), 0.95),
+            "n": len(v)}
+        for k, v in node.metrics.stage_seconds.items()}
+    summary = {
         "smoke": "tpu_node_admission", "platform": platform,
         "boot_self_test": "passed", "boot_s": round(boot_s, 1),
         "golden_cid": vec["golden"]["cid"], **live,
+        "task_to_commitment_p50_s": _pct(latencies, 0.50),
+        "task_to_commitment_p95_s": _pct(latencies, 0.95),
+        "task_to_commitment_s": [round(x, 2) for x in sorted(latencies)],
         "stage_seconds": stages,
         "elapsed_s": round(time.perf_counter() - _T0, 1),
-    }), flush=True)
+    }
+    print(json.dumps(summary), flush=True)
+    # committed artifact (bench_runs/ is the provenance directory)
+    out = os.path.join(_REPO, "bench_runs",
+                       f"r05_smoke_{platform}_{n_tasks}tasks.json")
+    with open(out, "w") as f:
+        json.dump(summary, f)
+    _note(f"summary written: {out}")
     return 0
 
 

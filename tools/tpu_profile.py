@@ -169,8 +169,12 @@ def main() -> None:
         v = jax.random.normal(jax.random.fold_in(key, 2), (bh, h, s, dd),
                               jnp.bfloat16)
         flops = 2 * 2 * bh * h * s * s * dd
-        for name, fn in (("flash", jax.jit(flash_attention)),
-                         ("einsum", jax.jit(sp_attention_reference))):
+        import functools
+        for name, fn in (
+                ("flash", jax.jit(flash_attention)),
+                ("flash_nopad", jax.jit(functools.partial(
+                    flash_attention, pad_d=False))),
+                ("einsum", jax.jit(sp_attention_reference))):
             try:
                 sec = _timeit(fn, q, k, v)
                 emit({"probe": "attention", "impl": name, "B": bh, "H": h,
