@@ -194,13 +194,29 @@ def _pad_to_mbs(plane: np.ndarray, mb: int) -> np.ndarray:
     return np.pad(plane, ((0, ph), (0, pw)), mode="edge")
 
 
+def _mb_blocks(plane: np.ndarray, mb: int) -> np.ndarray:
+    """[H, W] plane → [n_mbs, mb*mb] raster-ordered macroblock payloads."""
+    h, wd = plane.shape
+    return (plane.reshape(h // mb, mb, wd // mb, mb)
+            .transpose(0, 2, 1, 3).reshape(-1, mb * mb))
+
+
 def idr_slice_ipcm(y: np.ndarray, cb: np.ndarray, cr: np.ndarray,
                    idr_pic_id: int) -> bytes:
     """One IDR picture (single slice, all I_PCM macroblocks) as a NAL.
 
     y: uint8 [H,W] (H,W multiples of 16); cb/cr: uint8 [H/2,W/2].
+
+    Vectorized: after the slice header's first macroblock, every MB
+    starts byte-aligned, so its syntax is a CONSTANT 2-byte prefix
+    (ue(25) = '000011010' → 0x0D, then the 9th bit + 7 pcm-alignment
+    zeros → 0x00) followed by 384 raw sample bytes — the whole slice
+    body is one numpy concatenation instead of a 2304-iteration Python
+    loop per 1024×576 frame (~20× faster, byte-identical; equality vs
+    the scalar BitWriter construction is asserted in tests/test_h264.py).
     """
     mbs_h, mbs_w = y.shape[0] // 16, y.shape[1] // 16
+    n = mbs_h * mbs_w
     w = BitWriter()
     w.ue(0)            # first_mb_in_slice
     w.ue(7)            # slice_type: I (all slices in picture are I)
@@ -211,13 +227,18 @@ def idr_slice_ipcm(y: np.ndarray, cb: np.ndarray, cr: np.ndarray,
     w.u(0, 1)          # long_term_reference_flag
     w.se(0)            # slice_qp_delta
     w.ue(1)            # disable_deblocking_filter_idc: OFF (losslessness)
-    for my in range(mbs_h):
-        for mx in range(mbs_w):
-            w.ue(25)           # mb_type I_PCM
-            w.align_zero()     # pcm_alignment_zero_bit(s)
-            w.raw(y[my * 16:(my + 1) * 16, mx * 16:(mx + 1) * 16].tobytes())
-            w.raw(cb[my * 8:(my + 1) * 8, mx * 8:(mx + 1) * 8].tobytes())
-            w.raw(cr[my * 8:(my + 1) * 8, mx * 8:(mx + 1) * 8].tobytes())
+    # first MB via the bit writer (the header leaves an arbitrary bit
+    # position; ue(25) + pcm alignment re-aligns)
+    w.u(25 + 1, 9)     # ue(25): 4 zeros + '11010'
+    w.align_zero()
+    mb = np.concatenate([_mb_blocks(y, 16), _mb_blocks(cb, 8),
+                         _mb_blocks(cr, 8)], axis=1)   # [n, 384]
+    w.raw(mb[0].tobytes())
+    if n > 1:
+        body = np.concatenate(
+            [np.tile(np.array([[0x0D, 0x00]], np.uint8), (n - 1, 1)),
+             mb[1:]], axis=1)
+        w.raw(body.tobytes())
     w.trailing()
     return _nal(3, 5, w.bytes())
 

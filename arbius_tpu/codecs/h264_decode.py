@@ -71,8 +71,9 @@ def parse_sps(rbsp: bytes) -> dict:
         raise ValueError("high-profile SPS not supported by this decoder")
     log2_max_frame_num = r.ue() + 4
     poc_type = r.ue()
+    log2_max_poc_lsb = 0
     if poc_type == 0:
-        r.ue()
+        log2_max_poc_lsb = r.ue() + 4
     elif poc_type == 1:
         raise ValueError("poc_type 1 not supported")
     r.ue()   # max_num_ref_frames
@@ -88,6 +89,7 @@ def parse_sps(rbsp: bytes) -> dict:
         crop = [r.ue(), r.ue(), r.ue(), r.ue()]  # l, r, t, b (chroma units)
     return {"profile": profile, "level": level,
             "log2_max_frame_num": log2_max_frame_num,
+            "poc_type": poc_type, "log2_max_poc_lsb": log2_max_poc_lsb,
             "mbs_w": mbs_w, "mbs_h": mbs_h,
             "width": mbs_w * 16 - 2 * (crop[0] + crop[1]),
             "height": mbs_h * 16 - 2 * (crop[2] + crop[3])}
@@ -123,6 +125,10 @@ def decode_idr_ipcm(rbsp: bytes, sps: dict, pps: dict
     r.ue()                          # pps id
     r.u(sps["log2_max_frame_num"])  # frame_num
     r.ue()                          # idr_pic_id
+    if sps.get("poc_type", 2) == 0:
+        # poc_type-0 streams carry pic_order_cnt_lsb in EVERY slice
+        # header (7.3.3) — skipping it misaligns the macroblock parse
+        r.u(sps["log2_max_poc_lsb"])
     r.u(1); r.u(1)                  # dec_ref_pic_marking (IDR)
     r.se()                          # slice_qp_delta
     if pps["deblock_control"]:
@@ -153,7 +159,9 @@ def decode_idr_ipcm(rbsp: bytes, sps: dict, pps: dict
 
 def _avc_config(data: bytes) -> tuple[dict, dict]:
     """Parse avcC out of the avc1 sample entry → (sps, pps) dicts."""
-    s, e = _find(data, [b"moov", b"trak", b"mdia", b"minf", b"stbl", b"stsd"])
+    from arbius_tpu.codecs.mp4_demux import _video_stbl
+
+    s, e = _find(data, [b"stsd"], *_video_stbl(data))
     payload = data[s:e]
     # stsd: version/flags + entry_count, then the avc1 entry
     entry_start = s + 8

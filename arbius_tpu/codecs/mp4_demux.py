@@ -45,12 +45,32 @@ def _find(data: bytes, path: list[bytes], start=0, end=None):
     raise ValueError(f"box {path[0]!r} not found")
 
 
+def _video_stbl(data: bytes):
+    """(start, end) of the first VIDEO trak's stbl — external muxers
+    often put an audio trak first, so trak selection must check the
+    hdlr handler_type, not take the first trak."""
+    moov = _find(data, [b"moov"])
+    last_err = None
+    for tag, s, e in _boxes(data, *moov):
+        if tag != b"trak":
+            continue
+        try:
+            mdia = _find(data, [b"mdia"], s, e)
+            hs, _ = _find(data, [b"hdlr"], *mdia)
+            if data[hs + 8:hs + 12] != b"vide":
+                continue
+            return _find(data, [b"minf", b"stbl"], *mdia)
+        except ValueError as exc:
+            last_err = exc
+    raise ValueError(f"no video trak found ({last_err})")
+
+
 def demux_samples(data: bytes) -> list[bytes]:
     """Walk the full sample tables (stsz/stco/co64/stsc incl. run
     expansion) of the first video track → per-sample bytes. Shared by the
     MJPEG and H.264 demux paths — an external muxer may pack many samples
     per chunk, which a naive zip(stco, stsz) silently truncates."""
-    stbl = _find(data, [b"moov", b"trak", b"mdia", b"minf", b"stbl"])
+    stbl = _video_stbl(data)
     sizes = chunk_offsets = stsc = None
     for tag, s, e in _boxes(data, *stbl):
         if tag == b"stsz":
@@ -127,10 +147,9 @@ def decode_video_mp4(data: bytes) -> np.ndarray:
     entry: `avc1` (the framework's H.264 I_PCM class, codecs/h264.py)
     or MJPEG. The input side of the video-matting path."""
     try:
-        stsd_s, stsd_e = _find(data, [b"moov", b"trak", b"mdia", b"minf",
-                                      b"stbl", b"stsd"])
+        stsd_s, stsd_e = _find(data, [b"stsd"], *_video_stbl(data))
     except ValueError:
-        raise ValueError("not an ISO BMFF video file (no stsd)")
+        raise ValueError("not an ISO BMFF video file (no video stsd)")
     entry_tags = [tag for tag, _, _ in _boxes(data, stsd_s + 8, stsd_e)]
     if b"avc1" in entry_tags:
         from arbius_tpu.codecs.h264_decode import (

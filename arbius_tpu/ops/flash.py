@@ -115,10 +115,34 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     TPU + long sequences → the pallas flash kernel; otherwise the XLA
     einsum path (which XLA already fuses well at short S, and which is
     the only compiled option off-TPU).
+
+    ARBIUS_ATTN_IMPL overrides the dispatch for on-chip A/B measurement
+    (tools/tpu_profile.py drives the FULL UNet step under each value):
+    "flash" | "flash_nopad" | "einsum" | "auto" (default). All three are
+    exact attention; they differ in reduction order (ULP-class output
+    drift), so a fleet pins ONE impl per determinism class — changing
+    the production dispatch re-records the platform goldens.
     """
+    import os
+
     from arbius_tpu.ops.ring import sp_attention_reference
 
+    impl = os.environ.get("ARBIUS_ATTN_IMPL", "auto")
+    if impl not in ("auto", "flash", "flash_nopad", "einsum"):
+        # a typo must not silently measure/run a different impl than the
+        # label claims — the A/B exists to decide the production dispatch
+        raise ValueError(f"ARBIUS_ATTN_IMPL={impl!r} not in "
+                         "auto|flash|flash_nopad|einsum")
+    if impl == "einsum":
+        return sp_attention_reference(q, k, v)
     on_tpu = jax.default_backend() == "tpu"
+    if impl == "flash" and on_tpu:
+        return flash_attention(q, k, v)
+    if impl == "flash_nopad" and on_tpu:
+        return flash_attention(q, k, v, pad_d=False)
+    # flash impls requested off-TPU fall through here: einsum is the only
+    # compiled option off-TPU, so a fleet pinning "flash" still boots on
+    # CPU dev hosts (the profiler only labels non-auto impls on TPU)
     if on_tpu and q.shape[2] >= 1024:
         return flash_attention(q, k, v)
     return sp_attention_reference(q, k, v)

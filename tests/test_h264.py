@@ -206,3 +206,88 @@ def test_slice_header_with_deblocking_enabled_parses():
     dy, dcb, dcr = decode_idr_ipcm(rbsp, sps, pps)
     np.testing.assert_array_equal(dy, y)
     np.testing.assert_array_equal(dcb, c)
+
+
+def test_vectorized_slice_equals_scalar_construction():
+    """The numpy slice body must be byte-identical to the readable
+    per-MB BitWriter construction (the round-4 goldens pin these bytes)."""
+    from arbius_tpu.codecs.h264 import _nal, idr_slice_ipcm
+
+    rng = np.random.RandomState(21)
+    y = rng.randint(0, 256, (48, 32), np.uint8)
+    cb = rng.randint(0, 256, (24, 16), np.uint8)
+    cr = rng.randint(0, 256, (24, 16), np.uint8)
+
+    def scalar(y, cb, cr, idr_pic_id):
+        w = BitWriter()
+        w.ue(0); w.ue(7); w.ue(0)
+        w.u(0, 4)
+        w.ue(idr_pic_id & 1)
+        w.u(0, 1); w.u(0, 1)
+        w.se(0)
+        w.ue(1)
+        for my in range(y.shape[0] // 16):
+            for mx in range(y.shape[1] // 16):
+                w.ue(25)
+                w.align_zero()
+                w.raw(y[my*16:(my+1)*16, mx*16:(mx+1)*16].tobytes())
+                w.raw(cb[my*8:(my+1)*8, mx*8:(mx+1)*8].tobytes())
+                w.raw(cr[my*8:(my+1)*8, mx*8:(mx+1)*8].tobytes())
+        w.trailing()
+        return _nal(3, 5, w.bytes())
+
+    for pid in (0, 1):
+        assert idr_slice_ipcm(y, cb, cr, pid) == scalar(y, cb, cr, pid)
+
+
+def test_audio_trak_first_still_finds_video():
+    """External MP4s often put an audio trak before the video trak; the
+    demux must select by hdlr handler_type, not take the first trak."""
+    import struct
+
+    from arbius_tpu.codecs.mp4 import _box, _full
+    from arbius_tpu.codecs.mp4_demux import decode_video_mp4
+
+    frames = _frames(2, 32, 32, seed=4)
+    good = encode_mp4_h264(frames, fps=8)
+    # splice a minimal AUDIO trak (hdlr 'soun', empty stbl) before the
+    # real video trak inside moov
+    moov_off = good.rfind(b"moov") - 4
+    moov_size = struct.unpack(">I", good[moov_off:moov_off + 4])[0]
+    moov_body = good[moov_off + 8:moov_off + moov_size]
+    hdlr = _full(b"hdlr", 0, 0,
+                 struct.pack(">I", 0) + b"soun" + b"\x00" * 12 + b"a\x00")
+    audio_trak = _box(b"trak", _box(b"mdia", hdlr + _box(
+        b"minf", _box(b"stbl", b""))))
+    new_moov = _box(b"moov", audio_trak + moov_body)
+    data = good[:moov_off] + new_moov
+    decoded = decode_video_mp4(data)
+    assert decoded.shape == (2, 32, 32, 3)
+
+
+def test_poc_type0_slice_header_parses():
+    """poc_type-0 SPS puts pic_order_cnt_lsb in every slice header; the
+    decoder must consume it (external-stream compatibility)."""
+    from arbius_tpu.codecs.h264 import BitWriter
+    from arbius_tpu.codecs.h264_decode import decode_idr_ipcm
+
+    # hand-built poc_type-0 SPS dict (what parse_sps would produce)
+    sps = {"profile": 66, "level": 51, "log2_max_frame_num": 4,
+           "poc_type": 0, "log2_max_poc_lsb": 6,
+           "mbs_w": 1, "mbs_h": 1, "width": 16, "height": 16}
+    pps = {"pic_init_qp": 26, "deblock_control": 0}
+    y = np.arange(256, dtype=np.uint8).reshape(16, 16)
+    c = np.full((8, 8), 9, np.uint8)
+    w = BitWriter()
+    w.ue(0); w.ue(7); w.ue(0)
+    w.u(0, 4)                       # frame_num
+    w.ue(0)                         # idr_pic_id
+    w.u(33, 6)                      # pic_order_cnt_lsb (log2 6)
+    w.u(0, 1); w.u(0, 1)
+    w.se(0)
+    w.ue(25); w.align_zero()
+    w.raw(y.tobytes()); w.raw(c.tobytes()); w.raw(c.tobytes())
+    w.trailing()
+    dy, dcb, _ = decode_idr_ipcm(w.bytes(), sps, pps)
+    np.testing.assert_array_equal(dy, y)
+    np.testing.assert_array_equal(dcb, c)

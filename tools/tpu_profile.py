@@ -238,8 +238,9 @@ def main() -> None:
         emit({"probe": "segment", "name": "text_encoder",
               "error": f"{type(e).__name__}: {e}"})
 
-    # one CFG UNet step alone (2B batch, the scan body's cost)
-    hb.set("segment: unet step (CFG)")
+    # one CFG UNet step alone (2B batch, the scan body's cost) — under
+    # EACH attention impl: the program-level A/B that decides the
+    # production dispatch (kernel microbenches above miss fusion effects)
     try:
         xin = jax.random.normal(jax.random.PRNGKey(3),
                                 (2 * BATCH, lh, lw, cfg.unet.in_channels),
@@ -248,13 +249,37 @@ def main() -> None:
         ctx = jax.random.normal(jax.random.PRNGKey(4),
                                 (2 * BATCH, cfg.text.max_length,
                                  cfg.unet.context_dim), jnp.bfloat16)
-        un = jax.jit(lambda p, x, t, c: pipe.unet.apply({"params": p}, x, t, c))
-        sec = _timeit(un, params["unet"], xin, t, ctx)
-        emit({"probe": "segment", "name": "unet_step_cfg", "batch": BATCH,
-              "sec": round(sec, 5), "per_solve_x_steps": round(sec * steps_, 4)})
-    except Exception as e:
+        impls = ("auto",) if tiny else ("auto", "flash_nopad", "einsum")
+    except Exception as e:  # input setup failure must not cost the
+        # vae/full/trace probes (or the clean claim release)
         emit({"probe": "segment", "name": "unet_step_cfg",
-              "error": f"{type(e).__name__}: {e}"})
+              "error": f"setup: {type(e).__name__}: {e}"})
+        impls = ()
+    # restore the operator's pinned impl afterwards, not "auto" — the
+    # remaining probes (vae/full_generate/trace) must run under the env
+    # the operator launched with
+    prior_impl = os.environ.get("ARBIUS_ATTN_IMPL")
+    for impl in impls:
+        if impl != "auto" and _left(deadline) < 240:
+            _note(f"skipping unet A/B impl={impl} (budget)")
+            continue
+        hb.set(f"segment: unet step (CFG) attn={impl}")
+        try:
+            os.environ["ARBIUS_ATTN_IMPL"] = impl
+            un = jax.jit(lambda p, x, t, c: pipe.unet.apply(
+                {"params": p}, x, t, c))
+            sec = _timeit(un, params["unet"], xin, t, ctx)
+            emit({"probe": "segment", "name": "unet_step_cfg",
+                  "attn_impl": impl, "batch": BATCH, "sec": round(sec, 5),
+                  "per_solve_x_steps": round(sec * steps_, 4)})
+        except Exception as e:
+            emit({"probe": "segment", "name": "unet_step_cfg",
+                  "attn_impl": impl, "error": f"{type(e).__name__}: {e}"})
+        finally:
+            if prior_impl is None:
+                os.environ.pop("ARBIUS_ATTN_IMPL", None)
+            else:
+                os.environ["ARBIUS_ATTN_IMPL"] = prior_impl
 
     # VAE decode alone
     hb.set("segment: vae decode")
