@@ -89,6 +89,56 @@ def main() -> int:
         arm_exit_watchdog(_note, 90.0, code=1 if failing else 0)
 
 
+def run_live_burst(node, eng, user: str, mid_b: bytes, n_tasks: int,
+                   deadline: float, note,
+                   task_input: dict | None = None
+                   ) -> tuple[dict, list[float]]:
+    """Submit `n_tasks` at once and mine them through the full lifecycle,
+    recording each task's submission→solution-on-chain wall time (queue
+    wait + infer + CID + txs). Returns (summary, latencies). Extracted
+    from the TPU smoke session so the burst/claim bookkeeping is
+    CPU-testable (tests/test_smoke_burst.py) before it ever spends a
+    chip claim."""
+    base = task_input if task_input is not None else {
+        "negative_prompt": "", "width": 512, "height": 512,
+        "num_inference_steps": 20, "scheduler": "DPMSolverMultistep"}
+    live = {"attempted": True, "solved": 0, "claimed": 0,
+            "n_tasks": n_tasks, "solve_s": None}
+    claimed_before = node.metrics.solutions_claimed
+    latencies: list[float] = []
+    t_submit: dict[bytes, float] = {}
+    for i in range(n_tasks):
+        tid = eng.submit_task(user, 0, user, mid_b, 0, json.dumps({
+            "prompt": f"arbius smoke test {i}, a cat mining on a tpu",
+            **base}).encode())
+        t_submit[tid] = time.perf_counter()
+    note(f"{n_tasks} tasks submitted")
+    t0 = time.perf_counter()
+    pending = set(t_submit)
+
+    def drain_solved() -> None:
+        for tid in [t for t in pending if t in eng.solutions]:
+            # task-to-commitment wall time: burst submission →
+            # solution on chain
+            latencies.append(time.perf_counter() - t_submit[tid])
+            pending.discard(tid)
+
+    while node.tick() and time.perf_counter() < deadline:
+        drain_solved()
+    drain_solved()
+    live["solve_s"] = round(time.perf_counter() - t0, 1)
+    live["solved"] = n_tasks - len(pending)
+    note(f"{live['solved']}/{n_tasks} solved in {live['solve_s']}s")
+    if live["solved"]:
+        eng.advance_time(2200)
+        while node.tick() and time.perf_counter() < deadline + 120:
+            pass
+        # delta, not the node-lifetime counter: a reusable helper must
+        # not attribute earlier claims to this burst
+        live["claimed"] = node.metrics.solutions_claimed - claimed_before
+    return live, latencies
+
+
 def _post_claim(hb, vec, platform: str) -> int:
     from arbius_tpu.chain import WAD, Engine, TokenLedger
     from arbius_tpu.node import LocalChain, MinerNode
@@ -136,43 +186,16 @@ def _post_claim(hb, vec, platform: str) -> int:
     # sample. The boot self-test above already compiled the metric-shape
     # bucket, so the burst rides a warm executable.
     n_tasks = int(os.environ.get("SMOKE_TASKS", "20"))
-    live = {"attempted": False, "solved": 0, "claimed": 0,
-            "n_tasks": n_tasks, "solve_s": None}
-    latencies: list[float] = []
     if time.perf_counter() - _T0 < BUDGET_S - 300:
-        live["attempted"] = True
         hb.set(f"live burst: {n_tasks} tasks at the metric shape")
-        t_submit: dict[bytes, float] = {}
-        for i in range(n_tasks):
-            tid = eng.submit_task(user, 0, user, mid_b, 0, json.dumps({
-                "prompt": f"arbius smoke test {i}, a cat mining on a tpu",
-                "negative_prompt": "", "width": 512, "height": 512,
-                "num_inference_steps": 20,
-                "scheduler": "DPMSolverMultistep"}).encode())
-            t_submit[tid] = time.perf_counter()
-        _note(f"{n_tasks} tasks submitted")
-        t0 = time.perf_counter()
-        pending = set(t_submit)
-        deadline = _T0 + BUDGET_S - 240
-        while node.tick() and time.perf_counter() < deadline:
-            for tid in [t for t in pending if t in eng.solutions]:
-                # task-to-commitment wall time: burst submission →
-                # solution on chain (queue wait + infer + CID + txs)
-                latencies.append(time.perf_counter() - t_submit[tid])
-                pending.discard(tid)
-        for tid in [t for t in pending if t in eng.solutions]:
-            latencies.append(time.perf_counter() - t_submit[tid])
-            pending.discard(tid)
-        live["solve_s"] = round(time.perf_counter() - t0, 1)
-        live["solved"] = n_tasks - len(pending)
-        _note(f"{live['solved']}/{n_tasks} solved in {live['solve_s']}s")
-        if live["solved"]:
-            eng.advance_time(2200)
-            while node.tick() and time.perf_counter() < deadline + 120:
-                pass
-            live["claimed"] = node.metrics.solutions_claimed
+        live, latencies = run_live_burst(
+            node, eng, user, mid_b, n_tasks,
+            deadline=_T0 + BUDGET_S - 240, note=_note)
     else:
         _note("skipping live burst (budget)")
+        live = {"attempted": False, "solved": 0, "claimed": 0,
+                "n_tasks": n_tasks, "solve_s": None}
+        latencies = []
 
     def _pct(vals, q):
         if not vals:
