@@ -759,3 +759,59 @@ def test_movq_decoder_stage_matches_torch():
     ours = np.asarray(Stage().apply({"params": params}, nhwc(x), nhwc(z)))
     np.testing.assert_allclose(ours.transpose(0, 3, 1, 2), theirs,
                                atol=ATOL, rtol=RTOL)
+
+
+class _TorchPriorBlock(torch.nn.Module):
+    """Published prior block: pre-LN biased self-attention + exact-GELU
+    MLP (diffusers BasicTransformerBlock, attention_bias=True,
+    activation_fn='gelu', self-attention only)."""
+
+    def __init__(self, dim: int, heads: int):
+        super().__init__()
+        self.heads = heads
+        self.norm1 = torch.nn.LayerNorm(dim, eps=1e-5)
+        self.to_q = torch.nn.Linear(dim, dim)
+        self.to_k = torch.nn.Linear(dim, dim)
+        self.to_v = torch.nn.Linear(dim, dim)
+        self.to_out = torch.nn.Linear(dim, dim)
+        self.norm3 = torch.nn.LayerNorm(dim, eps=1e-5)
+        self.ff_in = torch.nn.Linear(dim, 4 * dim)
+        self.ff_out = torch.nn.Linear(4 * dim, dim)
+
+    def forward(self, x):
+        b, s, d = x.shape
+        hd = d // self.heads
+        h = self.norm1(x)
+        split = lambda t: t.view(b, s, self.heads, hd).transpose(1, 2)
+        o = torch.nn.functional.scaled_dot_product_attention(
+            split(self.to_q(h)), split(self.to_k(h)), split(self.to_v(h)))
+        x = x + self.to_out(o.transpose(1, 2).reshape(b, s, d))
+        h = self.norm3(x)
+        return x + self.ff_out(torch.nn.functional.gelu(self.ff_in(h)))
+
+
+def test_kandinsky_prior_block_matches_torch():
+    """A FULL kandinsky prior transformer block ≡ the published biased-
+    attention + exact-GELU forward."""
+    from arbius_tpu.models.kandinsky2.prior import PriorBlock
+
+    torch.manual_seed(15)
+    dim, heads = 16, 4
+    tm = _TorchPriorBlock(dim, heads).eval()
+    x = torch.randn(2, 9, dim)
+    with torch.no_grad():
+        theirs = tm(x).numpy()
+
+    g = lambda t: t.detach().numpy()
+    lin = lambda m: {"kernel": _linear(g(m.weight)), "bias": g(m.bias)}
+    params = {
+        "norm1": {"scale": g(tm.norm1.weight), "bias": g(tm.norm1.bias)},
+        "attn1": {"to_q": lin(tm.to_q), "to_k": lin(tm.to_k),
+                  "to_v": lin(tm.to_v), "to_out": lin(tm.to_out)},
+        "norm3": {"scale": g(tm.norm3.weight), "bias": g(tm.norm3.bias)},
+        "ff_in": lin(tm.ff_in),
+        "ff_out": lin(tm.ff_out),
+    }
+    ours = np.asarray(PriorBlock(heads, dim // heads, jnp.float32).apply(
+        {"params": params}, jnp.asarray(x.numpy())))
+    np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
