@@ -815,3 +815,77 @@ def test_kandinsky_prior_block_matches_torch():
     ours = np.asarray(PriorBlock(heads, dim // heads, jnp.float32).apply(
         {"params": params}, jnp.asarray(x.numpy())))
     np.testing.assert_allclose(ours, theirs, atol=ATOL, rtol=RTOL)
+
+
+class _TorchInvertedResidual(torch.nn.Module):
+    """torchvision MobileNetV3 InvertedResidual with SE + hardswish, the
+    RVM encoder's block class (expand 1x1 + depthwise + SE + project,
+    BN eps 1e-3, residual on shape match)."""
+
+    def __init__(self, cin: int, k: int, exp: int, cout: int):
+        super().__init__()
+        def bn(c):
+            m = torch.nn.BatchNorm2d(c, eps=1e-3)
+            m.running_mean.uniform_(-0.2, 0.2)
+            m.running_var.uniform_(0.7, 1.3)
+            return m
+        self.expand = torch.nn.Conv2d(cin, exp, 1, bias=False)
+        self.bn1 = bn(exp)
+        self.dw = torch.nn.Conv2d(exp, exp, k, padding=(k - 1) // 2,
+                                  groups=exp, bias=False)
+        self.bn2 = bn(exp)
+        sq = (exp // 4 + 4) // 8 * 8  # torchvision _make_divisible(exp/4)
+        self.fc1 = torch.nn.Conv2d(exp, sq, 1)
+        self.fc2 = torch.nn.Conv2d(sq, exp, 1)
+        self.project = torch.nn.Conv2d(exp, cout, 1, bias=False)
+        self.bn3 = bn(cout)
+        self.res = cin == cout
+
+    def forward(self, x):
+        hs = torch.nn.functional.hardswish
+        h = hs(self.bn1(self.expand(x)))
+        h = hs(self.bn2(self.dw(h)))
+        s = h.mean((2, 3), keepdim=True)
+        s = torch.nn.functional.hardsigmoid(
+            self.fc2(torch.relu(self.fc1(s))))
+        h = h * s
+        h = self.bn3(self.project(h))
+        return x + h if self.res else h
+
+
+def test_rvm_encoder_block_matches_torch():
+    """A FULL MobileNetV3 InvertedResidual (expand+depthwise+SE+project,
+    inference-form BN, hardswish/hardsigmoid) ≡ torchvision semantics —
+    the RVM encoder-side counterpart of the decoder-stage test."""
+    from arbius_tpu.models.rvm.model import InvertedResidual
+
+    torch.manual_seed(16)
+    cin, k, exp, cout = 8, 3, 24, 8
+    tm = _TorchInvertedResidual(cin, k, exp, cout).eval()
+    x = torch.randn(2, cin, 6, 6)
+    with torch.no_grad():
+        theirs = tm(x).numpy()
+
+    g = lambda t: t.detach().numpy()
+    def bn_params(m):
+        return {"scale": g(m.weight), "bias": g(m.bias),
+                "mean": g(m.running_mean), "var": g(m.running_var)}
+    def dwconv(w):  # torch [C,1,k,k] grouped -> flax [k,k,1,C]
+        return g(w).transpose(2, 3, 1, 0)
+    params = {
+        "expand": {"conv": {"kernel": _conv(g(tm.expand.weight))},
+                   "bn": bn_params(tm.bn1)},
+        "depthwise": {"conv": {"kernel": dwconv(tm.dw.weight)},
+                      "bn": bn_params(tm.bn2)},
+        "se": {"fc1": {"kernel": _conv(g(tm.fc1.weight)),
+                       "bias": g(tm.fc1.bias)},
+               "fc2": {"kernel": _conv(g(tm.fc2.weight)),
+                       "bias": g(tm.fc2.bias)}},
+        "project": {"conv": {"kernel": _conv(g(tm.project.weight))},
+                    "bn": bn_params(tm.bn3)},
+    }
+    row = (cin, k, exp, cout, True, "hardswish", 1, 1)
+    ours = np.asarray(InvertedResidual(row, jnp.float32).apply(
+        {"params": params}, jnp.asarray(x.numpy().transpose(0, 2, 3, 1))))
+    np.testing.assert_allclose(ours.transpose(0, 3, 1, 2), theirs,
+                               atol=ATOL, rtol=RTOL)
