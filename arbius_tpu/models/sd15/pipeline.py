@@ -222,8 +222,15 @@ class SD15Pipeline:
         num_inference_steps: int = 20,
         guidance_scale: float | list[float] = 7.5,
         scheduler: str = "DDIM",
+        as_device: bool = False,
     ) -> np.ndarray:
-        """Run a shape bucket; returns uint8 images [B, H, W, 3]."""
+        """Run a shape bucket; returns uint8 images [B, H, W, 3].
+
+        `as_device=True` returns the jax.Array WITHOUT forcing the
+        device→host transfer: JAX dispatch is asynchronous, so the caller
+        can queue the next bucket's dispatch and convert this result
+        while the chip crunches it (the solver's codec/CID overlap —
+        node/solver.py). Same bits either way."""
         batch = len(prompts)
         if len(negative_prompts) != batch or len(seeds) != batch:
             raise ValueError("prompts/negative_prompts/seeds must align")
@@ -257,4 +264,6 @@ class SD15Pipeline:
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
         images = fn(params, *args)
+        if as_device:
+            return images
         return np.asarray(images)

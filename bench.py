@@ -591,6 +591,44 @@ def _session_body(out_path: str, hb: _Heartbeat, left) -> None:
                 f"on real TPU)",
                 "stage_batch_sweep", f"sweep_b{b}"))
 
+    # -- sustained node-path rate: the REAL solver path (solve_cid_batch:
+    # inference + PNG + CID, chunk-pipelined so host codec overlaps chip
+    # compute) over a deep queue at canonical_batch 4 — the rate a
+    # queue-saturated miner actually sustains. Rides the ladder's warm
+    # executables (same pipe + params16 instance).
+    if params16 is not None and left() > 240:
+        try:
+            from arbius_tpu.node.solver import (
+                RegisteredModel,
+                SD15Runner,
+                solve_cid_batch,
+            )
+            from arbius_tpu.templates.engine import hydrate_input, load_template
+
+            hb.set("sustained node-path rate (pipelined, batch 4)")
+            tmpl = load_template("anythingv3")
+            model = RegisteredModel(id="0x" + "00" * 32, template=tmpl,
+                                    runner=SD15Runner(pipe, params16))
+            raw = {"prompt": "arbius bench task", "negative_prompt": "",
+                   "width": WIDTH, "height": HEIGHT,
+                   "num_inference_steps": STEPS, "scheduler": SCHEDULER}
+            hyd = hydrate_input(dict(raw), tmpl)
+            n_items = 12  # 3 chunks of 4: enough for the pipeline to fill
+            solve_cid_batch(model, [(hyd, 5000)], canonical_batch=1)  # warm
+            t0 = time.perf_counter()
+            solve_cid_batch(model, [(hyd, 6000 + i) for i in range(n_items)],
+                            canonical_batch=4)
+            sec = (time.perf_counter() - t0) / n_items
+            track(_prod_line(
+                3600.0 / sec,
+                f"solutions/hour/chip (SD-1.5 512x512, {STEPS} steps, "
+                f"{SCHEDULER}, CFG, bf16, canonical_batch=4, SUSTAINED "
+                f"node path incl. PNG+CID, PNG encode chunk-pipelined "
+                f"with chip compute — measured on real TPU)",
+                "stage_sustained_node_path", "sustained_b4"))
+        except Exception as e:
+            _note(f"sustained stage failed: {type(e).__name__}: {e}")
+
     # -- headline: the best number must survive any later-stage overrun,
     # so it is emitted HERE, immediately after the ladder — and RE-emitted
     # after the family stages below so the driver's last-line read still
