@@ -225,7 +225,8 @@ class Kandinsky2Pipeline:
                  width: int = 768, height: int = 768,
                  num_inference_steps: int = 50,
                  guidance_scale: float | list[float] = 4.0,
-                 scheduler: str = "DDIM") -> np.ndarray:
+                 scheduler: str = "DDIM",
+                 as_device: bool = False) -> np.ndarray:
         batch = len(prompts)
         if len(seeds) != batch:
             raise ValueError("prompts/seeds must align")
@@ -253,4 +254,9 @@ class Kandinsky2Pipeline:
             jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
-        return np.asarray(fn(params, *args))
+        images = fn(params, *args)
+        if as_device:
+            # async-dispatch handle: the solver's chunk pipeline encodes
+            # the previous chunk while the chip crunches this one
+            return images
+        return np.asarray(images)
