@@ -2,7 +2,7 @@
 
 End-to-end jitted program per shape bucket: text encode → CFG UNet3D
 denoise scan → per-frame VAE decode → uint8 frames. The node's video
-runner encodes the frames to deterministic MJPEG/MP4 (codecs.encode_mp4)
+runner encodes the frames to deterministic H.264 MP4 (codecs.encode_mp4_h264)
 and CIDs the bytes — replacing the reference's cog container + ffmpeg
 black box (`templates/zeroscopev2xl.json` out-1.mp4).
 

@@ -180,7 +180,7 @@ class Kandinsky2Runner:
 
 
 class Text2VideoRunner:
-    """zeroscope/damo-template runner: UNet3D → deterministic MJPEG MP4.
+    """zeroscope/damo-template runner: UNet3D → deterministic H.264 MP4.
 
     Template variables (templates/zeroscopev2xl.json / damo.json): prompt,
     negative_prompt (zeroscope), num_frames, num_inference_steps,
