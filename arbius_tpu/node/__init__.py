@@ -21,6 +21,7 @@ from arbius_tpu.node.node import BootError, MinerNode, NodeMetrics
 from arbius_tpu.node.pinners import HttpDaemonPinner, LocalPinner, PinMismatchError
 from arbius_tpu.node.retry import RetriesExhausted, expretry
 from arbius_tpu.node.rpc_chain import ChainRpcError, RpcChain
+from arbius_tpu.obs import Obs
 from arbius_tpu.node.store import ContentStore, cid_b58
 from arbius_tpu.node.solver import (
     Kandinsky2Runner,
@@ -38,7 +39,7 @@ __all__ = [
     "ContentStore", "DeploymentConfig", "HttpDaemonPinner", "Job",
     "Kandinsky2Runner", "LocalChain", "LocalPinner", "MinerNode",
     "MiningConfig", "ModelConfig", "ModelRegistry", "NodeDB",
-    "NodeMetrics", "PinMismatchError", "RVMRunner", "RegisteredModel",
+    "NodeMetrics", "Obs", "PinMismatchError", "RVMRunner", "RegisteredModel",
     "RetriesExhausted", "RpcChain", "SD15Runner", "StakeConfig",
     "Text2VideoRunner", "build_registry", "cid_b58", "expretry",
     "load_config", "load_deployment", "solve_cid", "solve_files",

@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Callable
 
 from arbius_tpu.chain import Engine, EngineError
+from arbius_tpu.obs import span
 
 
 def _b(hexstr: str) -> bytes:
@@ -90,15 +91,17 @@ class LocalChain:
     # Each tx mines a block afterward (hardhat-automine style): on the real
     # chain a commit tx always lands in an earlier block than the reveal,
     # which the engine's "commitment must be in past" check requires.
-    def _tx(self, fn):
-        result = fn()
-        self.engine.mine_block()
+    def _tx(self, fn, op: str = "tx"):
+        with span("chain." + op):
+            result = fn()
+            self.engine.mine_block()
         return result
 
     def submit_task(self, version: int, owner: str, model: str, fee: int,
                     input_: bytes) -> str:
         return _h(self._tx(lambda: self.engine.submit_task(
-            self.address, version, owner, _b(model), fee, input_)))
+            self.address, version, owner, _b(model), fee, input_),
+            op="submit_task"))
 
     def ensure_fee_allowance(self, fee: int) -> None:
         """Approve the engine to pull `fee` before submitTask — EngineV1
@@ -106,34 +109,37 @@ class LocalChain:
         if fee and self.engine.token.allowances.get(
                 (self.address, self.engine.ADDRESS), 0) < fee:
             self._tx(lambda: self.engine.token.approve(
-                self.address, self.engine.ADDRESS, fee))
+                self.address, self.engine.ADDRESS, fee), op="approve")
 
     def signal_commitment(self, commitment: bytes) -> None:
         self._tx(lambda: self.engine.signal_commitment(
-            self.address, commitment))
+            self.address, commitment), op="signal_commitment")
 
     def submit_solution(self, taskid: str, cid: str) -> None:
         self._tx(lambda: self.engine.submit_solution(
-            self.address, _b(taskid), _b(cid)))
+            self.address, _b(taskid), _b(cid)), op="submit_solution")
 
     def claim_solution(self, taskid: str) -> None:
-        self._tx(lambda: self.engine.claim_solution(self.address, _b(taskid)))
+        self._tx(lambda: self.engine.claim_solution(
+            self.address, _b(taskid)), op="claim_solution")
 
     def submit_contestation(self, taskid: str) -> None:
         self._tx(lambda: self.engine.submit_contestation(
-            self.address, _b(taskid)))
+            self.address, _b(taskid)), op="submit_contestation")
 
     def vote_on_contestation(self, taskid: str, yea: bool) -> None:
         self._tx(lambda: self.engine.vote_on_contestation(
-            self.address, _b(taskid), yea))
+            self.address, _b(taskid), yea), op="vote_on_contestation")
 
     def contestation_vote_finish(self, taskid: str, amnt: int) -> None:
         self._tx(lambda: self.engine.contestation_vote_finish(
-            self.address, _b(taskid), amnt))
+            self.address, _b(taskid), amnt),
+            op="contestation_vote_finish")
 
     def validator_deposit(self, amount: int) -> None:
         self._tx(lambda: self.engine.validator_deposit(
-            self.address, self.validator_address, amount))
+            self.address, self.validator_address, amount),
+            op="validator_deposit")
 
     def generate_commitment(self, taskid: str, cid: str) -> bytes:
         return self.engine.generate_commitment(self.address, _b(taskid),

@@ -124,6 +124,15 @@ class MiningConfig:
     canonical_batch: int = 1
     profile_dir: str | None = None   # jax.profiler trace output dir
     profile_every: int = 0           # trace every Nth solve dispatch
+    # obs subsystem (docs/observability.md): span tracing + event journal.
+    # obs_enabled=False stops span/journal recording (counters and the
+    # /metrics registry stay live — the JSON metrics view depends on them);
+    # obs_journal_capacity bounds the flight-recorder ring buffer.
+    obs_enabled: bool = True
+    obs_journal_capacity: int = 4096
+    # bound on expretry's base**attempt backoff curve (seconds); None
+    # preserves the reference's uncapped curve (utils.ts:21-39)
+    retry_max_delay: float | None = 30.0
     compile_cache_dir: str | None = ".jax_cache"  # persistent XLA cache
     store_dir: str | None = None     # content store root (None: don't pin)
     rpc_port: int | None = None      # control RPC + explorer + /ipfs gateway
@@ -147,6 +156,11 @@ class MiningConfig:
             raise ConfigError(
                 f"delegated_validator {self.delegated_validator!r} is not "
                 "a 0x address")
+        if self.obs_journal_capacity < 1:
+            raise ConfigError("obs_journal_capacity must be >= 1")
+        if self.retry_max_delay is not None and self.retry_max_delay <= 0:
+            raise ConfigError("retry_max_delay must be positive (or null "
+                              "for the uncapped reference curve)")
 
 
 @dataclass(frozen=True)

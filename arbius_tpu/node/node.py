@@ -25,8 +25,6 @@ from __future__ import annotations
 import json
 import logging
 import time
-from collections import deque
-from dataclasses import dataclass, field
 
 from arbius_tpu.l0.commitment import taskid2seed
 from arbius_tpu.node.chain_client import EngineError, LocalChain
@@ -34,6 +32,7 @@ from arbius_tpu.node.config import MiningConfig
 from arbius_tpu.node.db import Job, NodeDB
 from arbius_tpu.node.retry import RetriesExhausted, expretry
 from arbius_tpu.node.solver import ModelRegistry, solve_cid, solve_cid_batch
+from arbius_tpu.obs import Obs, span, use_obs
 from arbius_tpu.templates.engine import (
     HydrationError,
     MiningFilter,
@@ -45,25 +44,54 @@ log = logging.getLogger("arbius.node")
 
 MINER_VERSION = 0  # versionCheck: chain version must be <= ours
 
+# lifecycle counters, exposed as arbius_<name>_total on GET /metrics and
+# as attributes of the NodeMetrics back-compat view
+_COUNTERS = {
+    "solutions_submitted": "Solutions revealed on-chain",
+    "solutions_claimed": "Solution rewards claimed",
+    "contestations_submitted": "Contestations this node initiated",
+    "votes_cast": "Contestation votes cast",
+    "vote_finishes": "contestationVoteFinish calls that paid out",
+    "tasks_unprofitable": "Tasks skipped by the profitability gate",
+    "tasks_seen": "TaskSubmitted events observed",
+    "tasks_invalid": "Tasks marked invalid (bad version or input)",
+}
 
-@dataclass
+
 class NodeMetrics:
-    solutions_submitted: int = 0
-    solutions_claimed: int = 0
-    contestations_submitted: int = 0
-    votes_cast: int = 0
-    vote_finishes: int = 0
-    tasks_unprofitable: int = 0
-    tasks_seen: int = 0
-    tasks_invalid: int = 0
-    # rolling windows (deque maxlen): percentiles reflect RECENT behavior
-    # and memory stays bounded on long-running miners
-    solve_latency: deque = field(
-        default_factory=lambda: deque(maxlen=1000))  # (taskid, chain s)
-    # wall-clock stage spans per solve dispatch (SURVEY.md §5 tracing):
-    # infer = model + encode + CID; commit = chain txs for the bucket
-    stage_seconds: dict = field(default_factory=lambda: {
-        "infer": deque(maxlen=1000), "commit": deque(maxlen=1000)})
+    """Back-compat view over the obs registry (docs/observability.md).
+
+    Pre-obs this was a dataclass of ints and rolling deques; the registry
+    is now the single source of truth and this view derives the same
+    attribute surface from it: counter attributes read the
+    `arbius_*_total` counters, `solve_latency` / `stage_seconds` read the
+    histograms' bounded recent-sample windows.
+    """
+
+    def __init__(self, obs: Obs):
+        self._obs = obs
+
+    def __getattr__(self, name: str):
+        if name in _COUNTERS:
+            return int(self._obs.registry.counter(
+                f"arbius_{name}_total").value())
+        raise AttributeError(name)
+
+    @property
+    def solve_latency(self) -> list:
+        """Recent (taskid, chain-seconds) pairs, newest last."""
+        return self._obs.registry.histogram(
+            "arbius_solve_latency_chain_seconds").recent()
+
+    @property
+    def stage_seconds(self) -> dict:
+        """Recent wall-clock seconds per solve stage: infer = model +
+        encode + CID for a bucket dispatch; commit = chain txs for the
+        bucket (SURVEY.md §5 tracing)."""
+        h = self._obs.registry.histogram("arbius_stage_seconds",
+                                         labelnames=("stage",))
+        return {"infer": h.values(stage="infer"),
+                "commit": h.values(stage="commit")}
 
 
 class BootError(RuntimeError):
@@ -88,7 +116,28 @@ class MinerNode:
 
             pinner = build_pinner(config.ipfs, store)
         self.pinner = pinner
-        self.metrics = NodeMetrics()
+        self.obs = Obs(journal_capacity=config.obs_journal_capacity,
+                       now_fn=lambda: self.chain.now,
+                       enabled=config.obs_enabled)
+        reg = self.obs.registry
+        for name, help_text in _COUNTERS.items():
+            reg.counter(f"arbius_{name}_total", help_text)
+        self._h_stage = reg.histogram(
+            "arbius_stage_seconds",
+            "Wall-clock seconds per solve stage (infer=model+encode+CID "
+            "per bucket dispatch, commit=chain txs per bucket)",
+            labelnames=("stage",))
+        self._h_latency = reg.histogram(
+            "arbius_solve_latency_chain_seconds",
+            "Chain-time seconds from solve dispatch to accepted solution")
+        self._c_jobs_failed = reg.counter(
+            "arbius_jobs_failed_total",
+            "Jobs quarantined to failed_jobs, by method",
+            labelnames=("method",))
+        reg.gauge("arbius_queue_depth",
+                  "Jobs currently in the queue (due or waiting)",
+                  fn=self.db.job_count)
+        self.metrics = NodeMetrics(self.obs)
         self._retry_sleep = lambda s: None  # injectable; chain time is fake
 
     # -- boot (start.ts:11-52 + index.ts:971-1020) -----------------------
@@ -142,8 +191,18 @@ class MinerNode:
                     f"boot self-test failed for {mid}: got {got}, "
                     f"expected {expected} — nondeterministic build/hardware")
 
+    def _inc(self, name: str, **labels) -> None:
+        self.obs.registry.counter(f"arbius_{name}_total").inc(**labels)
+
     # -- event handlers ---------------------------------------------------
     def _on_event(self, ev) -> None:
+        # events can arrive outside tick() (the local engine pushes
+        # synchronously from any tx, including RPC-thread submits), so
+        # the handler activates this node's obs itself
+        with use_obs(self.obs):
+            self._dispatch_event(ev)
+
+    def _dispatch_event(self, ev) -> None:
         name = ev.name
         if name == "TaskSubmitted":
             self._on_task_submitted(ev.args)
@@ -166,12 +225,13 @@ class MinerNode:
     def _on_task_submitted(self, args: dict) -> None:
         taskid = "0x" + args["id"].hex()
         model = "0x" + args["model"].hex()
-        self.metrics.tasks_seen += 1
+        self._inc("tasks_seen")
         if self.registry.get(model) is None:
             return
-        self.db.store_task(taskid, model, args["fee"], args["sender"],
-                           self.chain.now, 0, "")
-        self.db.queue_job("task", {"taskid": taskid}, concurrent=True)
+        with span("task.event", taskid=taskid, model=model):
+            self.db.store_task(taskid, model, args["fee"], args["sender"],
+                               self.chain.now, 0, "")
+            self.db.queue_job("task", {"taskid": taskid}, concurrent=True)
 
     def _sync_solution(self, taskid: str) -> None:
         sol = self.chain.get_solution(taskid)
@@ -216,6 +276,10 @@ class MinerNode:
     def tick(self) -> int:
         """One poll: run due concurrent jobs, then one serial pass.
         Returns number of jobs processed."""
+        with use_obs(self.obs):
+            return self._tick()
+
+    def _tick(self) -> int:
         # pull-based backends (RpcChain) deliver events here; the local
         # engine pushes synchronously and has no poll_events. A transport
         # blip must not kill the run() loop — the next tick re-polls the
@@ -257,15 +321,26 @@ class MinerNode:
             }.get(job.method)
             if handler is None:
                 log.error("unknown job method %s", job.method)
-                self.db.fail_job(job)
+                self._fail_job(job, ValueError("unknown job method"))
                 return 0
-            handler(job.data)
+            with span("job." + job.method,
+                      taskid=job.data.get("taskid"), job_id=job.id):
+                handler(job.data)
             self.db.delete_job(job.id)
             return 1
         except Exception as e:  # noqa: BLE001 — failed_jobs quarantine
             log.warning("job %s failed: %r", job.method, e)
-            self.db.fail_job(job)
+            self._fail_job(job, e)
             return 0
+
+    def _fail_job(self, job: Job, e: Exception) -> None:
+        """failed_jobs quarantine + the obs failure record (counter +
+        journal) — retry/failure visibility the reference lacks."""
+        self._c_jobs_failed.inc(method=job.method)
+        self.obs.event("job_failed", method=job.method,
+                       taskid=job.data.get("taskid"),
+                       error=f"{type(e).__name__}: {e}")
+        self.db.fail_job(job)
 
     # -- processors -------------------------------------------------------
     def _process_task(self, data: dict) -> None:
@@ -276,7 +351,7 @@ class MinerNode:
             raise ValueError(f"task {taskid} not on chain")
         if task.version != 0:
             self.db.mark_invalid_task(taskid)
-            self.metrics.tasks_invalid += 1
+            self._inc("tasks_invalid")
             return
         model_id = "0x" + task.model.hex()
         m = self.registry.get(model_id)
@@ -292,7 +367,7 @@ class MinerNode:
         if not result.filter_passed:
             return
         if not self._fee_covers_cost(task.fee):
-            self.metrics.tasks_unprofitable += 1
+            self._inc("tasks_unprofitable")
             log.info("task %s fee %d below cost floor — skipping",
                      taskid, task.fee)
             return
@@ -300,13 +375,16 @@ class MinerNode:
         if raw is None:
             raise ValueError(f"no input bytes for {taskid}")
         try:
-            obj = json.loads(raw.decode("utf-8"))
-            hydrated = hydrate_input(obj, m.template)
+            with span("task.hydrate", taskid=taskid, model=model_id):
+                obj = json.loads(raw.decode("utf-8"))
+                hydrated = hydrate_input(obj, m.template)
         except (ValueError, HydrationError) as e:
             # invalid input: remember, so any solution gets contested
             log.info("task %s invalid input: %r", taskid, e)
             self.db.mark_invalid_task(taskid)
-            self.metrics.tasks_invalid += 1
+            self._inc("tasks_invalid")
+            self.obs.event("task_invalid", taskid=taskid,
+                           error=f"{type(e).__name__}: {e}")
             return
         hydrated["seed"] = taskid2seed(taskid)
         self.db.store_task_input(taskid, "", hydrated)
@@ -326,7 +404,7 @@ class MinerNode:
         rate = self.config.min_fee_per_second
         if rate <= 0:
             return True
-        samples = self.metrics.stage_seconds["infer"]
+        samples = self._h_stage.values(stage="infer")
         if samples:
             est = sorted(samples)[len(samples) // 2]
         else:
@@ -346,7 +424,7 @@ class MinerNode:
         for job in jobs:
             hydrated = self.db.get_task_input(job.data["taskid"])
             if hydrated is None:
-                self.db.fail_job(job)
+                self._fail_job(job, ValueError("no stored task input"))
                 continue
             by_bucket.setdefault(
                 self._bucket_key(job.data["model"], hydrated), []).append(
@@ -354,35 +432,43 @@ class MinerNode:
         done = 0
         for (model_id, *_), entries in by_bucket.items():
             m = self.registry.get(model_id)
-            t_start = self.chain.now
-            w_start = time.perf_counter()
+            taskids = [job.data["taskid"] for job, _ in entries]
+            with span("solve.batch", model=model_id, n=len(entries),
+                      taskids=taskids):
+                done += self._solve_bucket(m, entries)
+        return done
+
+    def _solve_bucket(self, m, entries: list[tuple[Job, dict]]) -> int:
+        t_start = self.chain.now
+        w_start = time.perf_counter()
+        try:
+            with self._maybe_profile():
+                results = solve_cid_batch(
+                    m, [(h, h["seed"]) for _, h in entries],
+                    evilmode=self.config.evilmode,
+                    canonical_batch=self.config.canonical_batch)
+        except Exception as e:  # noqa: BLE001 — whole bucket failed
+            log.warning("bucket solve failed: %r", e)
+            for job, _ in entries:
+                self._fail_job(job, e)
+            return 0
+        self._h_stage.observe(time.perf_counter() - w_start, stage="infer")
+        done = 0
+        w_commit = time.perf_counter()
+        for (job, _), (cid, files) in zip(entries, results):
             try:
-                with self._maybe_profile():
-                    results = solve_cid_batch(
-                        m, [(h, h["seed"]) for _, h in entries],
-                        evilmode=self.config.evilmode,
-                        canonical_batch=self.config.canonical_batch)
-            except Exception as e:  # noqa: BLE001 — whole bucket failed
-                log.warning("bucket solve failed: %r", e)
-                for job, _ in entries:
-                    self.db.fail_job(job)
-                continue
-            self.metrics.stage_seconds["infer"].append(
-                time.perf_counter() - w_start)
-            w_commit = time.perf_counter()
-            for (job, _), (cid, files) in zip(entries, results):
-                try:
+                with span("solve.task", taskid=job.data["taskid"], cid=cid):
                     # pin BEFORE revealing: a revealed CID whose bytes are
-                    # nowhere fetchable is exactly what contestation slashes
+                    # nowhere fetchable is exactly what contestation
+                    # slashes
                     self._store_solution(job.data["taskid"], cid, files)
                     self._commit_reveal(job.data["taskid"], cid, t_start)
-                    self.db.delete_job(job.id)
-                    done += 1
-                except Exception as e:  # noqa: BLE001
-                    log.warning("solve commit failed: %r", e)
-                    self.db.fail_job(job)
-            self.metrics.stage_seconds["commit"].append(
-                time.perf_counter() - w_commit)
+                self.db.delete_job(job.id)
+                done += 1
+            except Exception as e:  # noqa: BLE001
+                log.warning("solve commit failed: %r", e)
+                self._fail_job(job, e)
+        self._h_stage.observe(time.perf_counter() - w_commit, stage="commit")
         return done
 
     def _store_solution(self, taskid: str, cid: str, files: dict) -> None:
@@ -400,31 +486,36 @@ class MinerNode:
         from arbius_tpu.node.pinners import LocalPinner
         from arbius_tpu.node.retry import expretry
 
-        mirrored = False
-        if self.store is not None and not isinstance(self.pinner, LocalPinner):
-            stored = cid_hex(self.store.put_files(files))
-            if stored != cid:
-                # the mirror may end up the only copy (remote pin can
-                # fail below) — never let a silently-corrupt sole copy
-                # back a reveal
-                log.error("mirror/commit CID mismatch: %s != %s", stored, cid)
-            mirrored = stored == cid
-        if self.pinner is None:
-            return
-        try:
-            pinned = cid_hex(expretry(
-                lambda: self.pinner.pin_files(files, taskid=taskid),
-                sleep=self._retry_sleep))
-        except Exception as e:  # noqa: BLE001 — availability decision below
-            if not mirrored:
-                raise  # no copy exists anywhere: block the reveal
-            log.error("pinning %s failed (serving from local mirror): %r",
-                      taskid, e)
-            return
-        if pinned != cid:
-            # same pure function on the same bytes; a mismatch means disk
-            # corruption or a codec bug — keep mining but say so loudly
-            log.error("pin/commit CID mismatch: %s != %s", pinned, cid)
+        with span("solve.pin", taskid=taskid, n=len(files)):
+            mirrored = False
+            if self.store is not None and \
+                    not isinstance(self.pinner, LocalPinner):
+                stored = cid_hex(self.store.put_files(files))
+                if stored != cid:
+                    # the mirror may end up the only copy (remote pin can
+                    # fail below) — never let a silently-corrupt sole copy
+                    # back a reveal
+                    log.error("mirror/commit CID mismatch: %s != %s",
+                              stored, cid)
+                mirrored = stored == cid
+            if self.pinner is None:
+                return
+            try:
+                pinned = cid_hex(expretry(
+                    lambda: self.pinner.pin_files(files, taskid=taskid),
+                    max_delay=self.config.retry_max_delay,
+                    sleep=self._retry_sleep, op="pin_files"))
+            except Exception as e:  # noqa: BLE001 — availability decision
+                if not mirrored:
+                    raise  # no copy exists anywhere: block the reveal
+                log.error("pinning %s failed (serving from local mirror): "
+                          "%r", taskid, e)
+                return
+            if pinned != cid:
+                # same pure function on the same bytes; a mismatch means
+                # disk corruption or a codec bug — keep mining but say so
+                # loudly
+                log.error("pin/commit CID mismatch: %s != %s", pinned, cid)
 
     def _process_pin_task_input(self, data: dict) -> None:
         """Pin the raw task input through the configured strategy (the
@@ -444,7 +535,8 @@ class MinerNode:
             # quarantine the job and lose contestation evidence
             expretry(lambda: self.pinner.pin_blob(raw,
                                                   filename=data["taskid"]),
-                     sleep=self._retry_sleep)
+                     max_delay=self.config.retry_max_delay,
+                     sleep=self._retry_sleep, op="pin_blob")
 
     def _maybe_profile(self):
         """jax.profiler trace around every Nth solve dispatch when the
@@ -473,17 +565,19 @@ class MinerNode:
                 self.db.mark_invalid_task(taskid)
                 self.db.queue_job("contest", {"taskid": taskid}, priority=50)
             return
-        commitment = self.chain.generate_commitment(taskid, cid)
+        with span("solve.commit", taskid=taskid):
+            commitment = self.chain.generate_commitment(taskid, cid)
+            try:
+                self.chain.signal_commitment(commitment)
+            except EngineError:
+                pass  # already signalled (e.g. replay); reveal decides
         try:
-            self.chain.signal_commitment(commitment)
-        except EngineError:
-            pass  # already signalled (e.g. replay); reveal decides
-        try:
-            expretry(lambda: self.chain.submit_solution(taskid, cid),
-                     tries=3, sleep=self._retry_sleep)
-            self.metrics.solutions_submitted += 1
-            self.metrics.solve_latency.append(
-                (taskid, self.chain.now - t_start))
+            with span("solve.reveal", taskid=taskid):
+                expretry(lambda: self.chain.submit_solution(taskid, cid),
+                         tries=3, max_delay=self.config.retry_max_delay,
+                         sleep=self._retry_sleep, op="submit_solution")
+            self._inc("solutions_submitted")
+            self._h_latency.observe(self.chain.now - t_start, tag=taskid)
             self.db.queue_job(
                 "claim", {"taskid": taskid},
                 waituntil=self.chain.now
@@ -502,21 +596,22 @@ class MinerNode:
         if self.chain.get_contestation(taskid) is not None:
             return  # resolved via contestationVoteFinish instead
         expretry(lambda: self.chain.claim_solution(taskid),
-                 tries=3, sleep=self._retry_sleep)
-        self.metrics.solutions_claimed += 1
+                 tries=3, max_delay=self.config.retry_max_delay,
+                 sleep=self._retry_sleep, op="claim_solution")
+        self._inc("solutions_claimed")
 
     def _process_contest(self, data: dict) -> None:
         """index.ts:674-707: contest, or pile onto an existing one."""
         taskid = data["taskid"]
         try:
             self.chain.submit_contestation(taskid)
-            self.metrics.contestations_submitted += 1
+            self._inc("contestations_submitted")
             self._queue_vote_finish(taskid)
         except EngineError:
             if not self.chain.contestation_voted(taskid) and \
                     self.chain.validator_can_vote(taskid) == 0:
                 self.chain.vote_on_contestation(taskid, True)
-                self.metrics.votes_cast += 1
+                self._inc("votes_cast")
                 self._queue_vote_finish(taskid)
 
     def _process_vote(self, data: dict) -> None:
@@ -527,7 +622,7 @@ class MinerNode:
         if self.chain.validator_can_vote(taskid) != 0:
             return
         self.chain.vote_on_contestation(taskid, data["yea"])
-        self.metrics.votes_cast += 1
+        self._inc("votes_cast")
         self._queue_vote_finish(taskid)
 
     def _queue_vote_finish(self, taskid: str) -> None:
@@ -563,7 +658,7 @@ class MinerNode:
             return
         try:
             self.chain.contestation_vote_finish(taskid, 64)
-            self.metrics.vote_finishes += 1
+            self._inc("vote_finishes")
         except EngineError as e:
             log.info("voteFinish %s: %r (already finished?)", taskid, e)
 

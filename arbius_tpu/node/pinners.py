@@ -20,6 +20,7 @@ from typing import Protocol
 from arbius_tpu.l0.base58 import b58encode
 from arbius_tpu.l0.cid import cid_of_solution_files
 from arbius_tpu.node.store import ContentStore
+from arbius_tpu.obs import span
 
 
 class Pinner(Protocol):
@@ -40,10 +41,13 @@ class LocalPinner:
         self.store = store
 
     def pin_files(self, files: dict[str, bytes], taskid: str = "") -> bytes:
-        return self.store.put_files(files)
+        with span("pin.files", strategy="local", n=len(files),
+                  taskid=taskid or None):
+            return self.store.put_files(files)
 
     def pin_blob(self, content: bytes, filename: str = "input") -> bytes:
-        return self.store.put_blob(content)
+        with span("pin.blob", strategy="local", size=len(content)):
+            return self.store.put_blob(content)
 
 
 class PinMismatchError(RuntimeError):
@@ -85,7 +89,9 @@ class HttpDaemonPinner:
             headers={"Content-Type":
                      f"multipart/form-data; boundary={self.BOUNDARY}"},
             method="POST")
-        with self.opener(req, timeout=self.timeout) as r:
+        with span("pin.files", strategy="http_daemon", n=len(files),
+                  taskid=taskid or None), \
+                self.opener(req, timeout=self.timeout) as r:
             lines = [json.loads(l) for l in r.read().splitlines() if l]
         # the dir-wrap root is the entry with empty Name (ipfs.ts:42-47)
         roots = [e["Hash"] for e in lines if e.get("Name", "") == ""]
@@ -107,7 +113,8 @@ class HttpDaemonPinner:
             headers={"Content-Type":
                      f"multipart/form-data; boundary={self.BOUNDARY}"},
             method="POST")
-        with self.opener(req, timeout=self.timeout) as r:
+        with span("pin.blob", strategy="http_daemon", size=len(content)), \
+                self.opener(req, timeout=self.timeout) as r:
             lines = [json.loads(l) for l in r.read().splitlines() if l]
         got = lines[-1]["Hash"] if lines else None
         if got != b58encode(local):
@@ -159,7 +166,9 @@ class PinataPinner:
                      f"multipart/form-data; boundary={self.BOUNDARY}",
                      "Authorization": f"Bearer {self.jwt}"},
             method="POST")
-        with self.opener(req, timeout=self.timeout) as r:
+        with span("pin.files", strategy="pinata", n=len(files),
+                  taskid=taskid or None), \
+                self.opener(req, timeout=self.timeout) as r:
             got = json.loads(r.read()).get("IpfsHash")
         if got != b58encode(local_root):
             raise PinMismatchError(
@@ -187,7 +196,8 @@ class PinataPinner:
                      f"multipart/form-data; boundary={self.BOUNDARY}",
                      "Authorization": f"Bearer {self.jwt}"},
             method="POST")
-        with self.opener(req, timeout=self.timeout) as r:
+        with span("pin.blob", strategy="pinata", size=len(content)), \
+                self.opener(req, timeout=self.timeout) as r:
             got = json.loads(r.read()).get("IpfsHash")
         if got != b58encode(local):
             raise PinMismatchError(

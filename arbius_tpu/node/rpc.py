@@ -1,22 +1,24 @@
 """Operator control RPC — job injection + introspection + metrics.
 
 Mirror of the reference's express API (`miner/src/rpc.ts:15-95`:
-/api/jobs/queue, /api/jobs/get, /api/jobs/delete) plus the metrics
-endpoint the reference lacks (SURVEY.md §5 observability: solutions/hour,
-latency percentiles, queue depth). stdlib http.server, localhost-bound —
-this is an operator-only surface, exactly like the reference's.
+/api/jobs/queue, /api/jobs/get, /api/jobs/delete) plus the observability
+surface the reference lacks (SURVEY.md §5, docs/observability.md):
+`/api/metrics` (JSON view, derived from the obs registry), `/metrics`
+(Prometheus text exposition), and `/debug/trace` + `/debug/journal`
+(the obs journal's span trees and raw flight-recorder events). stdlib
+http.server, localhost-bound — this is an operator-only surface,
+exactly like the reference's.
+
+View dispatch is wrapped: a view that raises returns a 500 JSON error
+(and increments `arbius_rpc_errors_total`) instead of killing the
+request thread silently mid-response.
 """
 from __future__ import annotations
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-import numpy as np
-
-
-def _p50(values):
-    return float(np.median(values)) if values else None
+from urllib.parse import parse_qs, urlsplit
 
 
 class ControlRPC:
@@ -44,7 +46,32 @@ class ControlRPC:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, text: str, content_type: str):
+                body = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
+                try:
+                    self._route_get()
+                except (BrokenPipeError, ConnectionError):
+                    pass  # client went away mid-response; nothing to send
+                except Exception as e:  # noqa: BLE001 — view bug must
+                    # answer 500, not die silently (and be counted)
+                    outer._view_error(self, e)
+
+            def do_POST(self):
+                try:
+                    self._route_post()
+                except (BrokenPipeError, ConnectionError):
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    outer._view_error(self, e)
+
+            def _route_get(self):
                 if self.path == "/" or self.path == "/explorer":
                     self._send_html(outer.explorer_html())
                 elif self.path.startswith("/task/"):
@@ -66,6 +93,15 @@ class ControlRPC:
                         "data": j.data} for j in jobs])
                 elif self.path == "/api/metrics":
                     self._send(200, outer.metrics())
+                elif self.path == "/metrics":
+                    # Prometheus text exposition (0.0.4) straight from the
+                    # obs registry — the scrape surface for dashboards
+                    self._send_text(outer.prometheus_text(),
+                                    "text/plain; version=0.0.4; "
+                                    "charset=utf-8")
+                elif self.path.startswith("/debug/"):
+                    code, payload = outer.debug_view(self.path)
+                    self._send(code, payload)
                 elif self.path == "/api/chain/info":
                     self._send(200, outer.chain_info())
                 elif self.path.startswith("/ipfs/"):
@@ -73,7 +109,7 @@ class ControlRPC:
                 else:
                     self._send(404, {"error": "not found"})
 
-            def do_POST(self):
+            def _route_post(self):
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -491,8 +527,14 @@ class ControlRPC:
             "</table></body></html>")
 
     def metrics(self) -> dict:
+        """JSON metrics view — same keys as pre-obs, now DERIVED from the
+        obs registry (one source of truth; percentiles come from the
+        histograms' rolling recent-sample windows)."""
         m = self.node.metrics
-        lat = [s for _, s in m.solve_latency]
+        reg = self.node.obs.registry
+        lat = reg.histogram("arbius_solve_latency_chain_seconds")
+        stage = reg.histogram("arbius_stage_seconds",
+                              labelnames=("stage",))
         return {
             "tasks_seen": m.tasks_seen,
             "tasks_invalid": m.tasks_invalid,
@@ -503,11 +545,51 @@ class ControlRPC:
             "vote_finishes": m.vote_finishes,
             "tasks_unprofitable": m.tasks_unprofitable,
             "queue_depth": self.node.db.job_count(),
-            "solve_latency_p50": _p50(lat),
-            "solve_latency_p95": float(np.percentile(lat, 95)) if lat else None,
-            "stage_infer_p50_s": _p50(m.stage_seconds.get("infer", [])),
-            "stage_commit_p50_s": _p50(m.stage_seconds.get("commit", [])),
+            "solve_latency_p50": lat.percentile(0.5),
+            "solve_latency_p95": lat.percentile(0.95),
+            "stage_infer_p50_s": stage.percentile(0.5, stage="infer"),
+            "stage_commit_p50_s": stage.percentile(0.5, stage="commit"),
         }
+
+    def prometheus_text(self) -> str:
+        return self.node.obs.registry.render()
+
+    def debug_view(self, path: str) -> tuple[int, object]:
+        """GET /debug/trace?taskid=0x… → the task's span trees;
+        GET /debug/journal[?limit=N&kind=K] → raw journal events."""
+        parts = urlsplit(path)
+        q = parse_qs(parts.query)
+        if parts.path == "/debug/trace":
+            taskid = (q.get("taskid") or [""])[0]
+            if not taskid:
+                return 400, {"error": "taskid query parameter required"}
+            trace = self.node.obs.task_trace(taskid)
+            return 200, {"taskid": taskid, "spans": trace,
+                         "journal_dropped": self.node.obs.journal.dropped}
+        if parts.path == "/debug/journal":
+            try:
+                limit = int((q.get("limit") or ["200"])[0])
+            except ValueError:
+                return 400, {"error": "limit must be an integer"}
+            kind = (q.get("kind") or [None])[0]
+            events = self.node.obs.journal.events(kind=kind, limit=limit)
+            return 200, {"events": events,
+                         "capacity": self.node.obs.journal.capacity,
+                         "dropped": self.node.obs.journal.dropped}
+        return 404, {"error": "not found"}
+
+    def _view_error(self, handler, e: Exception) -> None:
+        """A failing view answers 500 JSON and is counted — never a
+        silently-dead request thread (pre-obs behavior)."""
+        obs = getattr(self.node, "obs", None)
+        if obs is not None:
+            obs.registry.counter(
+                "arbius_rpc_errors_total",
+                "Control-RPC views that raised (answered as 500)").inc()
+        try:
+            handler._send(500, {"error": f"{type(e).__name__}: {e}"})
+        except Exception:  # noqa: BLE001 — headers already sent / socket
+            pass           # gone: nothing more we can do for this request
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.server.serve_forever,

@@ -29,6 +29,9 @@ from arbius_tpu.chain.rpc_client import (
 )
 from arbius_tpu.l0.abi import abi_decode
 from arbius_tpu.l0.commitment import generate_commitment
+import re as _re
+
+from arbius_tpu.obs import span
 
 log = logging.getLogger("arbius.rpc_chain")
 
@@ -238,10 +241,14 @@ class RpcChain:
 
     # -- transactions ------------------------------------------------------
     def _send(self, fn: str, values: list) -> str:
-        try:
-            return self.client.send(fn, values)
-        except RpcError as e:
-            raise _engine_error(e) from None
+        # span names are snake_case (LocalChain parity — one taxonomy for
+        # local and production nodes, docs/observability.md)
+        op = _re.sub(r"(?<=[a-z0-9])([A-Z])", r"_\1", fn).lower()
+        with span("chain." + op):
+            try:
+                return self.client.send(fn, values)
+            except RpcError as e:
+                raise _engine_error(e) from None
 
     def ensure_fee_allowance(self, fee: int) -> None:
         """Approve the engine to pull `fee` before submitTask — same

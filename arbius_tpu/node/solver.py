@@ -20,6 +20,7 @@ import numpy as np
 
 from arbius_tpu.codecs import encode_png
 from arbius_tpu.l0.cid import cid_hex, cid_of_solution_files
+from arbius_tpu.obs import span
 from arbius_tpu.templates.engine import Template, load_template
 
 Runner = Callable[[dict, int], dict]
@@ -76,6 +77,13 @@ def solve_files_batch(model: RegisteredModel, items: list[tuple[dict, int]],
     and one bucket ⇒ one program ⇒ one determinism class. Runners without
     `run_batch` are the canonical_batch=1 case by construction.
     """
+    with span("solve.infer", n=len(items), batch=canonical_batch):
+        return _solve_files_batch(model, items,
+                                  canonical_batch=canonical_batch)
+
+
+def _solve_files_batch(model: RegisteredModel, items: list[tuple[dict, int]],
+                       *, canonical_batch: int = 1) -> list[dict]:
     run_batch = getattr(model.runner, "run_batch", None)
     if run_batch is None or canonical_batch <= 1:
         return [solve_files(model, h, s) for h, s in items]
@@ -121,7 +129,8 @@ def solve_cid(model: RegisteredModel, hydrated: dict, seed: int,
     if evilmode:
         return EVIL_CID, {}
     files = solve_files(model, hydrated, seed)
-    return cid_hex(cid_of_solution_files(files)), files
+    with span("solve.cid", n=1):
+        return cid_hex(cid_of_solution_files(files)), files
 
 
 def solve_cid_batch(model: RegisteredModel, items: list[tuple[dict, int]],
@@ -130,11 +139,11 @@ def solve_cid_batch(model: RegisteredModel, items: list[tuple[dict, int]],
     """Batched solve_cid over one shape bucket."""
     if evilmode:
         return [(EVIL_CID, {})] * len(items)
-    out = []
-    for files in solve_files_batch(model, items,
-                                   canonical_batch=canonical_batch):
-        out.append((cid_hex(cid_of_solution_files(files)), files))
-    return out
+    files_list = solve_files_batch(model, items,
+                                   canonical_batch=canonical_batch)
+    with span("solve.cid", n=len(files_list)):
+        return [(cid_hex(cid_of_solution_files(files)), files)
+                for files in files_list]
 
 
 class Kandinsky2Runner:
@@ -174,9 +183,10 @@ class Kandinsky2Runner:
         )
 
     def finalize(self, images, n_real: int) -> list[dict]:
-        images = np.asarray(images)
-        return [{self.out_name: encode_png(images[i])}
-                for i in range(n_real)]
+        with span("solve.encode", n=n_real, codec="png"):
+            images = np.asarray(images)
+            return [{self.out_name: encode_png(images[i])}
+                    for i in range(n_real)]
 
 
 class Text2VideoRunner:
@@ -216,7 +226,9 @@ class Text2VideoRunner:
             num_inference_steps=int(g("num_inference_steps")),
             guidance_scale=float(g("guidance_scale")),
         )
-        return {self.out_name: encode_mp4_h264(frames[0], fps=int(g("fps")))}
+        with span("solve.encode", n=1, codec="h264"):
+            return {self.out_name: encode_mp4_h264(frames[0],
+                                                   fps=int(g("fps")))}
 
 
 class RVMRunner:
@@ -249,7 +261,8 @@ class RVMRunner:
         out = self.pipeline.matte(
             self.params, video,
             output_type=hydrated.get("output_type") or "green-screen")
-        return {self.out_name: encode_mp4_h264(out, fps=self.fps)}
+        with span("solve.encode", n=1, codec="h264"):
+            return {self.out_name: encode_mp4_h264(out, fps=self.fps)}
 
 
 class SD15Runner:
@@ -298,6 +311,7 @@ class SD15Runner:
         """Device result → per-item encoded files (blocks on the
         transfer, then host-side codec). Bytes identical to the
         unpipelined path: encode order and inputs are unchanged."""
-        images = np.asarray(images)
-        return [{self.out_name: encode_png(images[i])}
-                for i in range(n_real)]
+        with span("solve.encode", n=n_real, codec="png"):
+            images = np.asarray(images)
+            return [{self.out_name: encode_png(images[i])}
+                    for i in range(n_real)]

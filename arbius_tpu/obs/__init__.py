@@ -1,0 +1,102 @@
+"""arbius_tpu.obs — tracing, metrics registry, and event journal.
+
+The miner's observability subsystem (SURVEY.md §5: the reference ships
+none). Three pieces behind one facade:
+
+  - `MetricsRegistry`: counters / gauges / fixed-bucket histograms with
+    Prometheus text exposition (`ControlRPC` serves it at GET /metrics)
+    and bounded recent-sample windows for exact rolling percentiles.
+  - `Tracer`: `span(name, **attrs)` context managers with parent/child
+    nesting, wall-time + chain-time stamps, completed spans recorded
+    into the journal and `arbius_span_seconds{name}`.
+  - `EventJournal`: bounded ring buffer of span completions and
+    retry/failure events, queryable by taskid (GET /debug/trace) and
+    dumpable (`tools/obs_dump.py`).
+
+An `Obs` instance bundles the three; `MinerNode` owns one per node.
+Library code that should not know about nodes (solver, pinners, chain
+client, expretry) reports through the *ambient* obs: the node activates
+its instance around its event loop with `use_obs(...)`, and the
+module-level `span(...)` / `current_obs()` helpers are near-zero-cost
+no-ops when nothing is active — importing this package never makes an
+un-instrumented call path slower.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager, nullcontext
+from contextvars import ContextVar
+
+from arbius_tpu.obs.journal import EventJournal
+from arbius_tpu.obs.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from arbius_tpu.obs.trace import Span, Tracer, task_trace
+
+
+class Obs:
+    """One node's observability bundle: registry + journal + tracer.
+
+    `enabled=False` turns off tracing and journaling (the hot-path
+    per-span cost) while the registry keeps counting — the metrics
+    surface stays truthful either way.
+    """
+
+    def __init__(self, *, journal_capacity: int = 4096, now_fn=None,
+                 enabled: bool = True):
+        self.enabled = enabled
+        self.registry = MetricsRegistry()
+        self.journal = EventJournal(journal_capacity, now_fn=now_fn)
+        self.tracer = Tracer(self.journal, registry=self.registry,
+                             now_fn=now_fn, enabled=enabled)
+
+    def span(self, name: str, **attrs):
+        if not self.enabled:
+            return nullcontext()
+        return self.tracer.span(name, **attrs)
+
+    def event(self, kind: str, **fields) -> None:
+        """Record a non-span journal event (retry, job failure, …)."""
+        if self.enabled:
+            self.journal.record(kind, **fields)
+
+    def task_trace(self, taskid: str) -> list[dict]:
+        return task_trace(self.journal.events(), taskid)
+
+
+_ACTIVE: ContextVar[Obs | None] = ContextVar("arbius_obs", default=None)
+_NULL_CM = nullcontext()
+
+
+@contextmanager
+def use_obs(obs: Obs | None):
+    """Make `obs` the ambient observability sink for this context (the
+    node wraps its tick loop and event handlers in this)."""
+    token = _ACTIVE.set(obs)
+    try:
+        yield obs
+    finally:
+        _ACTIVE.reset(token)
+
+
+def current_obs() -> Obs | None:
+    return _ACTIVE.get()
+
+
+def span(name: str, **attrs):
+    """Ambient span: traces into the active Obs, no-op (a shared
+    reusable nullcontext — no allocation) when none is active."""
+    obs = _ACTIVE.get()
+    if obs is None or not obs.enabled:
+        return _NULL_CM
+    return obs.tracer.span(name, **attrs)
+
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "EventJournal", "Gauge", "Histogram",
+    "MetricsRegistry", "Obs", "Span", "Tracer", "current_obs", "span",
+    "task_trace", "use_obs",
+]

@@ -1,0 +1,374 @@
+"""Metrics registry — counters, gauges, fixed-bucket histograms.
+
+One process-local registry backs every surface that reports numbers:
+the node's `NodeMetrics` view, the JSON `/api/metrics` endpoint, the
+Prometheus `GET /metrics` exposition, and bench.py's per-stage BENCH
+snapshots. The reference miner has no metrics at all (SURVEY.md §5);
+the shape here follows the Prometheus client-library data model —
+monotonic counters, settable gauges (optionally collect-time callbacks),
+and histograms with fixed cumulative buckets — because that is what a
+learned performance model ("A Learned Performance Model for TPUs",
+PAPERS.md) and any fleet dashboard both consume.
+
+Histograms additionally keep a bounded window of recent raw samples
+(optionally tagged, e.g. with a taskid) so exact rolling percentiles —
+what the pre-obs `NodeMetrics` deques provided — derive from the same
+instrument instead of a parallel data structure.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_left
+from collections import deque
+
+# latency-shaped default: sub-ms RPC spans up to multi-minute video solves
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_label(v) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _label_str(labelnames: tuple, key: tuple) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(labelnames, key))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """Shared label-children plumbing. `key` is the tuple of label values
+    in `labelnames` order; the unlabeled metric uses the empty tuple."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: tuple = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+
+    def _key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.labelnames)}")
+        return tuple(labels[n] for n in self.labelnames)
+
+    def _child(self, labels: dict):
+        key = self._key(labels)
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._children[key] = self._new_child()
+            return c
+
+    def _peek(self, labels: dict):
+        """Read-only child lookup: never materializes a labeled series
+        (a scrape or percentile query must not create empty series)."""
+        key = self._key(labels)
+        with self._lock:
+            return self._children.get(key)
+
+    def _items(self) -> list[tuple[tuple, object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return [0.0]
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up "
+                             f"(inc {amount})")
+        c = self._child(labels)
+        with self._lock:
+            c[0] += amount
+
+    def value(self, **labels) -> float:
+        c = self._peek(labels)
+        return c[0] if c is not None else 0.0
+
+    def render(self) -> list[str]:
+        lines = [f"{self.name}{_label_str(self.labelnames, key)} "
+                 f"{_fmt_value(c[0])}" for key, c in self._items()]
+        if not lines and not self.labelnames:
+            lines = [f"{self.name} 0"]
+        return lines
+
+    def summary(self):
+        if not self.labelnames:
+            return self.value()
+        return {",".join(f"{n}={v}" for n, v in zip(self.labelnames, key)):
+                c[0] for key, c in self._items()}
+
+
+class Gauge(_Metric):
+    """Settable gauge; `fn` (unlabeled only) is read at collect time —
+    the queue-depth pattern, where the source of truth is elsewhere."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, labelnames: tuple = (),
+                 fn=None):
+        if fn is not None and labelnames:
+            raise ValueError(f"{name}: callback gauges cannot be labeled")
+        super().__init__(name, help, labelnames)
+        self.fn = fn
+
+    def _new_child(self):
+        return [0.0]
+
+    def set(self, value: float, **labels) -> None:
+        c = self._child(labels)
+        with self._lock:
+            c[0] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        c = self._child(labels)
+        with self._lock:
+            c[0] += amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def _call_fn(self) -> float:
+        try:
+            return float(self.fn())
+        except Exception:  # noqa: BLE001 — a dead source (e.g. a closed
+            # sqlite handle behind queue_depth) must not take down the
+            # whole /metrics scrape
+            return float("nan")
+
+    def value(self, **labels) -> float:
+        if self.fn is not None:
+            return self._call_fn()
+        c = self._peek(labels)
+        return c[0] if c is not None else 0.0
+
+    def render(self) -> list[str]:
+        if self.fn is not None:
+            return [f"{self.name} {_fmt_value(self._call_fn())}"]
+        lines = [f"{self.name}{_label_str(self.labelnames, key)} "
+                 f"{_fmt_value(c[0])}" for key, c in self._items()]
+        if not lines and not self.labelnames:
+            lines = [f"{self.name} 0"]
+        return lines
+
+    def summary(self):
+        if self.fn is not None or not self.labelnames:
+            return self.value()
+        return {",".join(f"{n}={v}" for n, v in zip(self.labelnames, key)):
+                c[0] for key, c in self._items()}
+
+
+class _HistChild:
+    __slots__ = ("counts", "sum", "count", "recent")
+
+    def __init__(self, n_buckets: int, window: int):
+        self.counts = [0] * (n_buckets + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self.recent: deque = deque(maxlen=window)  # (tag, value)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram plus a bounded recent-sample window.
+
+    Buckets are upper edges (cumulative at render, per the Prometheus
+    text format). `observe(v, tag=...)` keeps (tag, value) in the recent
+    window so `percentile()` / `recent()` answer the exact rolling-window
+    questions the JSON metrics view asks (p50/p95 over recent solves)
+    without a second data structure.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str,
+                 buckets: tuple = DEFAULT_BUCKETS, labelnames: tuple = (),
+                 recent_window: int = 1000):
+        super().__init__(name, help, labelnames)
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+        self.buckets = b
+        self.recent_window = int(recent_window)
+
+    def _new_child(self):
+        return _HistChild(len(self.buckets), self.recent_window)
+
+    def observe(self, value: float, tag=None, **labels) -> None:
+        c = self._child(labels)
+        i = bisect_left(self.buckets, value)
+        with self._lock:
+            c.counts[i] += 1
+            c.sum += value
+            c.count += 1
+            c.recent.append((tag, value))
+
+    def values(self, **labels) -> list[float]:
+        c = self._peek(labels)
+        if c is None:
+            return []
+        with self._lock:
+            return [v for _, v in c.recent]
+
+    def recent(self, **labels) -> list[tuple]:
+        c = self._peek(labels)
+        if c is None:
+            return []
+        with self._lock:
+            return list(c.recent)
+
+    def count(self, **labels) -> int:
+        c = self._peek(labels)
+        return c.count if c is not None else 0
+
+    def percentile(self, q: float, **labels) -> float | None:
+        """Exact percentile over the recent window (numpy 'linear'
+        interpolation semantics), None when no samples yet."""
+        vals = sorted(self.values(**labels))
+        if not vals:
+            return None
+        if len(vals) == 1:
+            return float(vals[0])
+        pos = q * (len(vals) - 1)
+        lo = int(pos)
+        frac = pos - lo
+        if lo + 1 >= len(vals):
+            return float(vals[-1])
+        return float(vals[lo] + (vals[lo + 1] - vals[lo]) * frac)
+
+    def render(self) -> list[str]:
+        lines = []
+        for key, c in self._items():
+            cum = 0
+            for edge, n in zip(self.buckets, c.counts):
+                cum += n
+                labels = _label_str(
+                    self.labelnames + ("le",), key + (_fmt_value(edge),))
+                lines.append(f"{self.name}_bucket{labels} {cum}")
+            labels = _label_str(self.labelnames + ("le",), key + ("+Inf",))
+            lines.append(f"{self.name}_bucket{labels} {c.count}")
+            base = _label_str(self.labelnames, key)
+            lines.append(f"{self.name}_sum{base} {_fmt_value(c.sum)}")
+            lines.append(f"{self.name}_count{base} {c.count}")
+        return lines
+
+    def summary(self):
+        out = {}
+        for key, c in self._items():
+            k = ",".join(f"{n}={v}" for n, v in zip(self.labelnames, key))
+            labels = dict(zip(self.labelnames, key))
+            out[k] = {
+                "count": c.count,
+                "sum": round(c.sum, 6),
+                "p50": self.percentile(0.5, **labels),
+                "p95": self.percentile(0.95, **labels),
+            }
+        if not self.labelnames:
+            return out.get("", {"count": 0, "sum": 0.0,
+                                "p50": None, "p95": None})
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create metric registry with Prometheus text exposition.
+
+    Re-registering a name returns the existing instrument; a kind or
+    labelnames mismatch raises — two call sites silently feeding
+    different-shaped metrics into one name is the bug this catches.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls) or m.labelnames != tuple(
+                        kwargs.get("labelnames", ())):
+                    raise ValueError(
+                        f"metric {name} re-registered as {cls.kind}"
+                        f"/{kwargs.get('labelnames', ())} but exists as "
+                        f"{m.kind}/{m.labelnames}")
+                if isinstance(m, Histogram) and (
+                        m.buckets != tuple(sorted(
+                            float(x) for x in kwargs["buckets"]))
+                        or m.recent_window != int(kwargs["recent_window"])):
+                    raise ValueError(
+                        f"histogram {name} re-registered with different "
+                        "buckets/recent_window — the existing layout "
+                        "would silently win")
+                return m
+            m = self._metrics[name] = cls(name, help, **kwargs)
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: tuple = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames=labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: tuple = (),
+              fn=None) -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, Gauge) or m.labelnames != tuple(labelnames):
+                    raise ValueError(f"metric {name} exists with a "
+                                     "different shape")
+                if fn is not None:
+                    m.fn = fn
+                return m
+            m = self._metrics[name] = Gauge(name, help, labelnames, fn=fn)
+            return m
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: tuple = DEFAULT_BUCKETS, labelnames: tuple = (),
+                  recent_window: int = 1000) -> Histogram:
+        return self._get_or_create(Histogram, name, help,
+                                   buckets=buckets, labelnames=labelnames,
+                                   recent_window=recent_window)
+
+    def get(self, name: str) -> _Metric | None:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def _sorted(self) -> list[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def render(self) -> str:
+        """Prometheus text exposition format (0.0.4)."""
+        out = []
+        for m in self._sorted():
+            if m.help:
+                out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            out.extend(m.render())
+        return "\n".join(out) + "\n"
+
+    def summary(self) -> dict:
+        """Compact JSON-able snapshot: {name: scalar | per-label dict}."""
+        return {m.name: m.summary() for m in self._sorted()}

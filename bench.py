@@ -603,6 +603,7 @@ def _session_body(out_path: str, hb: _Heartbeat, left) -> None:
                 SD15Runner,
                 solve_cid_batch,
             )
+            from arbius_tpu.obs import Obs, use_obs
             from arbius_tpu.templates.engine import hydrate_input, load_template
 
             hb.set("sustained node-path rate (pipelined, batch 4)")
@@ -615,9 +616,15 @@ def _session_body(out_path: str, hb: _Heartbeat, left) -> None:
             hyd = hydrate_input(dict(raw), tmpl)
             n_items = 12  # 3 chunks of 4: enough for the pipeline to fill
             solve_cid_batch(model, [(hyd, 5000)], canonical_batch=1)  # warm
+            # per-stage timing rides the obs registry (docs/observability
+            # .md): the BENCH line carries infer/encode/cid span stats so
+            # perf PRs can show which stage moved, not just the total
+            obs = Obs(journal_capacity=256)
             t0 = time.perf_counter()
-            solve_cid_batch(model, [(hyd, 6000 + i) for i in range(n_items)],
-                            canonical_batch=4)
+            with use_obs(obs):
+                solve_cid_batch(model,
+                                [(hyd, 6000 + i) for i in range(n_items)],
+                                canonical_batch=4)
             sec = (time.perf_counter() - t0) / n_items
             track(_prod_line(
                 3600.0 / sec,
@@ -625,7 +632,8 @@ def _session_body(out_path: str, hb: _Heartbeat, left) -> None:
                 f"{SCHEDULER}, CFG, bf16, canonical_batch=4, SUSTAINED "
                 f"node path incl. PNG+CID, PNG encode chunk-pipelined "
                 f"with chip compute — measured on real TPU)",
-                "stage_sustained_node_path", "sustained_b4"))
+                "stage_sustained_node_path", "sustained_b4",
+                {"obs": obs.registry.summary()}))
         except Exception as e:
             _note(f"sustained stage failed: {type(e).__name__}: {e}")
 

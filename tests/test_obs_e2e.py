@@ -1,0 +1,223 @@
+"""End-to-end obs tests: a full task lifecycle traced through
+`MinerNode.tick()` on the fake chain, the ControlRPC observability
+endpoints (/metrics Prometheus parse, /debug/trace span tree,
+/debug/journal), the 500-on-view-failure contract, obs_dump rendering,
+and the bounded-overhead acceptance check."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from arbius_tpu.chain import WAD
+from arbius_tpu.node.rpc import ControlRPC
+
+from test_node import build_world, drain, submit
+from test_obs import assert_valid_prometheus
+
+
+def _solved_world():
+    eng, tok, chain, node, mid = build_world()
+    tid = submit(eng, mid, fee=10 * WAD)
+    drain(node)
+    assert node.metrics.solutions_submitted == 1
+    return eng, tok, chain, node, mid, tid
+
+
+def _names(spans):
+    out = []
+    for sp in spans:
+        out.append(sp["name"])
+        out.extend(_names(sp.get("children") or []))
+    return out
+
+
+def test_full_lifecycle_trace_through_tick():
+    eng, tok, chain, node, mid, tid = _solved_world()
+    eng.advance_time(2000 + 121)
+    drain(node)
+    assert node.metrics.solutions_claimed == 1
+
+    roots = node.obs.task_trace(tid)
+    names = _names(roots)
+    # the ISSUE's lifecycle: event → hydrate → infer/batch → encode →
+    # CID → pin → commit → reveal → claim
+    for expected in ("task.event", "job.task", "task.hydrate",
+                     "solve.batch", "solve.infer", "solve.cid",
+                     "solve.task", "solve.pin", "solve.commit",
+                     "chain.signal_commitment", "solve.reveal",
+                     "chain.submit_solution", "job.claim",
+                     "chain.claim_solution"):
+        assert expected in names, f"{expected} missing from {names}"
+    # nesting: solve.infer and solve.task live under solve.batch
+    batch = next(sp for r in roots for sp in [r] + r["children"]
+                 if sp["name"] == "solve.batch")
+    batch_children = {c["name"] for c in batch["children"]}
+    assert {"solve.infer", "solve.cid", "solve.task"} <= batch_children
+    assert tid in batch["taskids"]
+    # chain-time stamps rode along
+    assert all("chain_start" in r for r in roots)
+    # per-task latency landed in the tagged histogram window
+    assert node.metrics.solve_latency[0][0] == tid
+    assert node.metrics.solve_latency[0][1] >= 0
+    # stage histogram fed by the bucket dispatch
+    assert len(node.metrics.stage_seconds["infer"]) == 1
+    assert len(node.metrics.stage_seconds["commit"]) == 1
+
+
+def test_failed_job_recorded_in_journal_and_counter():
+    eng, tok, chain, node, mid = build_world()
+    node.db.queue_job("task", {"taskid": "0x" + "77" * 32})  # not on chain
+    drain(node)
+    fails = node.obs.journal.events(kind="job_failed")
+    assert len(fails) == 1
+    assert fails[0]["method"] == "task" and "not on chain" in fails[0]["error"]
+    assert node.obs.registry.counter(
+        "arbius_jobs_failed_total", labelnames=("method",)).value(
+        method="task") == 1
+    # the failing span itself carries error status
+    spans = [e for e in node.obs.journal.events(kind="span")
+             if e["name"] == "job.task"]
+    assert spans and spans[-1]["status"] == "error"
+
+
+@pytest.fixture()
+def rpc_world():
+    eng, tok, chain, node, mid, tid = _solved_world()
+    rpc = ControlRPC(node, port=0)
+    rpc.start()
+    yield eng, node, rpc, tid
+    rpc.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        ctype = r.headers.get("Content-Type", "")
+        body = r.read().decode()
+    return ctype, body
+
+
+def test_metrics_endpoint_is_valid_prometheus(rpc_world):
+    eng, node, rpc, tid = rpc_world
+    ctype, text = _get(rpc.port, "/metrics")
+    assert ctype.startswith("text/plain")
+    samples = assert_valid_prometheus(text)
+    assert samples["arbius_solutions_submitted_total"] == 1
+    assert samples["arbius_tasks_seen_total"] == 1
+    assert samples["arbius_solve_latency_chain_seconds_count"] == 1
+    assert 'arbius_stage_seconds_count{stage="infer"}' in samples
+    assert 'arbius_span_seconds_count{name="solve.infer"}' in samples
+    assert "arbius_queue_depth" in samples
+    # JSON view is served off the same registry and keeps its keys
+    _, js = _get(rpc.port, "/api/metrics")
+    m = json.loads(js)
+    assert m["solutions_submitted"] == 1
+    assert m["solve_latency_p50"] is not None
+    assert m["stage_infer_p50_s"] is not None
+
+
+def test_debug_trace_endpoint_returns_span_tree(rpc_world):
+    eng, node, rpc, tid = rpc_world
+    _, body = _get(rpc.port, f"/debug/trace?taskid={tid}")
+    payload = json.loads(body)
+    assert payload["taskid"] == tid
+    names = _names(payload["spans"])
+    assert "solve.batch" in names and "solve.reveal" in names
+    # missing taskid → 400, not a dead thread
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(rpc.port, "/debug/trace")
+    assert ei.value.code == 400
+
+
+def test_debug_journal_endpoint(rpc_world):
+    eng, node, rpc, tid = rpc_world
+    _, body = _get(rpc.port, "/debug/journal?limit=5&kind=span")
+    payload = json.loads(body)
+    assert payload["capacity"] == node.config.obs_journal_capacity
+    assert 0 < len(payload["events"]) <= 5
+    assert all(e["kind"] == "span" for e in payload["events"])
+    # an operator typo is a 400 (client error), not a counted 500
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(rpc.port, "/debug/journal?limit=abc")
+    assert ei.value.code == 400
+    assert node.obs.registry.counter("arbius_rpc_errors_total").value() == 0
+
+
+def test_failing_view_returns_500_and_counts(rpc_world, monkeypatch):
+    eng, node, rpc, tid = rpc_world
+    monkeypatch.setattr(
+        rpc, "metrics", lambda: (_ for _ in ()).throw(RuntimeError("view!")))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(rpc.port, "/api/metrics")
+    assert ei.value.code == 500
+    assert "view!" in json.loads(ei.value.read().decode())["error"]
+    assert node.obs.registry.counter("arbius_rpc_errors_total").value() == 1
+    # the server thread survived: the next request still answers
+    _, body = _get(rpc.port, "/api/tasks")
+    assert json.loads(body)[0]["taskid"] == tid
+
+
+def test_obs_dump_renderers(rpc_world):
+    from obs_dump import fetch_json, render_journal, render_metrics, \
+        render_trace
+
+    eng, node, rpc, tid = rpc_world
+    base = f"http://127.0.0.1:{rpc.port}"
+    out = render_metrics(fetch_json(f"{base}/api/metrics"))
+    assert "solutions_submitted" in out
+    body = fetch_json(f"{base}/debug/trace?taskid={tid}")
+    tree = render_trace(body["spans"])
+    assert "job.task" in tree and "solve.infer" in tree and "ms" in tree
+    # children are indented under their parents
+    batch_line = next(l for l in tree.splitlines()
+                      if l.strip().startswith("solve.batch"))
+    infer_line = next(l for l in tree.splitlines()
+                      if l.strip().startswith("solve.infer"))
+    assert len(infer_line) - len(infer_line.lstrip()) > \
+        len(batch_line) - len(batch_line.lstrip())
+    jr = render_journal(
+        fetch_json(f"{base}/debug/journal?limit=10")["events"])
+    assert "span" in jr
+
+
+def test_journal_capacity_config_bounds_node_journal():
+    eng, tok, chain, node, mid = build_world(obs_journal_capacity=8)
+    for i in range(4):
+        submit(eng, mid, prompt=f"cat {i}", fee=10 * WAD)
+    drain(node)
+    assert len(node.obs.journal) == 8
+    assert node.obs.journal.dropped > 0
+
+
+# -- acceptance: bounded instrumentation overhead --------------------------
+
+def _burst_seconds(obs_enabled: bool, n_tasks: int = 8) -> float:
+    eng, tok, chain, node, mid = build_world(obs_enabled=obs_enabled)
+    for i in range(n_tasks):
+        submit(eng, mid, prompt=f"task {i}", fee=10 * WAD)
+    t0 = time.perf_counter()
+    drain(node, n=50)
+    dt = time.perf_counter() - t0
+    assert node.metrics.solutions_submitted == n_tasks
+    return dt
+
+
+@pytest.mark.slow
+def test_obs_overhead_bounded():
+    """test_smoke_burst-style run with obs on vs off: the tick loop may
+    not slow down more than 5% (plus a small absolute epsilon for timer
+    noise). Interleaved best-of-5 so scheduler jitter cancels."""
+    on, off = [], []
+    _burst_seconds(True)  # warm caches (sqlite, templates, imports)
+    for _ in range(5):
+        off.append(_burst_seconds(False))
+        on.append(_burst_seconds(True))
+    assert min(on) <= min(off) * 1.05 + 0.010, (min(on), min(off))

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""obs_dump — pretty-print a miner node's obs state over ControlRPC.
+
+Reads the observability surface a running node serves on its control
+RPC port (docs/observability.md) and renders it for a terminal:
+
+    python tools/obs_dump.py metrics                  # JSON metrics view
+    python tools/obs_dump.py prom                     # raw Prometheus text
+    python tools/obs_dump.py journal [--limit 50] [--kind retry]
+    python tools/obs_dump.py trace 0x<taskid>         # span tree
+
+Target selection: --url http://127.0.0.1:<rpc_port> (default port 8080,
+matching MiningConfig.example.json's rpc_port). The render functions
+are pure (tests drive them against an in-process ControlRPC).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def fetch_json(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.load(r)
+
+
+def fetch_text(url: str, timeout: float = 10.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def render_metrics(m: dict) -> str:
+    width = max(len(k) for k in m) if m else 0
+    lines = []
+    for k in sorted(m):
+        v = m[k]
+        if isinstance(v, float):
+            v = f"{v:.6g}"
+        lines.append(f"{k.ljust(width)}  {v}")
+    return "\n".join(lines)
+
+
+def _event_line(e: dict) -> str:
+    kind = e.get("kind", "?")
+    core = {k: v for k, v in e.items()
+            if k not in ("kind", "seq", "wall", "chain")}
+    chain = f" chain={e['chain']}" if "chain" in e else ""
+    return (f"#{e.get('seq', '?'):>6} {kind:<16}{chain} "
+            + json.dumps(core, sort_keys=True, default=str))
+
+
+def render_journal(events: list[dict]) -> str:
+    return "\n".join(_event_line(e) for e in events)
+
+
+def render_trace(roots: list[dict], indent: int = 0) -> str:
+    """Indented span tree: name, wall duration, chain span, status."""
+    out = []
+    for sp in roots:
+        dur_ms = sp.get("wall_s", 0.0) * 1000.0
+        chain = ""
+        if "chain_start" in sp and "chain_end" in sp:
+            dc = sp["chain_end"] - sp["chain_start"]
+            chain = f"  chain+{dc}s" if dc else ""
+        status = "" if sp.get("status") == "ok" else \
+            f"  !{sp.get('status')}: {sp.get('error', '')}"
+        attrs = sp.get("attrs") or {}
+        extra = ("  " + json.dumps(attrs, sort_keys=True, default=str)
+                 ) if attrs else ""
+        out.append(f"{'  ' * indent}{sp.get('name', '?'):<{max(1, 28 - 2 * indent)}}"
+                   f" {dur_ms:9.2f} ms{chain}{status}{extra}")
+        children = sp.get("children") or []
+        if children:
+            out.append(render_trace(children, indent + 1))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="obs_dump", description=__doc__)
+    p.add_argument("--url", default="http://127.0.0.1:8080",
+                   help="node control-RPC base URL")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("metrics", help="JSON metrics view (/api/metrics)")
+    sub.add_parser("prom", help="Prometheus exposition (/metrics)")
+    sp = sub.add_parser("journal", help="event journal (/debug/journal)")
+    sp.add_argument("--limit", type=int, default=200)
+    sp.add_argument("--kind", default=None,
+                    help="filter by event kind (span, retry, job_failed, …)")
+    sp = sub.add_parser("trace", help="span tree for a task (/debug/trace)")
+    sp.add_argument("taskid")
+    ns = p.parse_args(argv)
+    base = ns.url.rstrip("/")
+
+    if ns.cmd == "metrics":
+        print(render_metrics(fetch_json(f"{base}/api/metrics")))
+    elif ns.cmd == "prom":
+        print(fetch_text(f"{base}/metrics"), end="")
+    elif ns.cmd == "journal":
+        q = f"?limit={ns.limit}" + (f"&kind={ns.kind}" if ns.kind else "")
+        body = fetch_json(f"{base}/debug/journal{q}")
+        print(render_journal(body["events"]))
+        print(f"-- {len(body['events'])} event(s), capacity "
+              f"{body['capacity']}, dropped {body['dropped']}",
+              file=sys.stderr)
+    elif ns.cmd == "trace":
+        body = fetch_json(f"{base}/debug/trace?taskid={ns.taskid}")
+        if not body["spans"]:
+            print(f"no spans recorded for {ns.taskid} (journal may have "
+                  "evicted them; see obs_journal_capacity)",
+                  file=sys.stderr)
+            return 1
+        print(render_trace(body["spans"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
