@@ -1,0 +1,47 @@
+"""arbius_tpu.analysis — "detlint", the determinism & concurrency linter.
+
+The protocol's security model never re-executes a solve on-chain
+(PAPER.md, docs/determinism.md): the only defense against a consensus
+fork is that every node's solve→encode→CID path is bit-reproducible.
+This package machine-checks that invariant, the way the TPU compilation
+stack checks graph properties — statically, over the whole tree, on
+every PR (the tier-1 self-check in tests/test_analysis.py runs it over
+`arbius_tpu/` and fails on any non-baselined finding).
+
+Three rule families (docs/static-analysis.md has the full catalog):
+
+    DET1xx  determinism  — wall clock, host RNG, filesystem order,
+                           unsorted serialization, set iteration,
+                           runtime numeric-env mutation
+    JIT2xx  jit purity   — host escapes & global mutation inside
+                           jax.jit/pjit-compiled functions
+    CONC3xx concurrency  — unlocked attributes shared with
+                           threading.Thread targets
+
+Escape hatches: inline `# detlint: allow[RULE] reason` pragmas and the
+checked-in `detlint-baseline.json`; `# detlint: enforce[RULE]` makes a
+file immune to both. CLI: `python -m arbius_tpu.analysis` or
+`tools/detlint.py` (exit 0 clean / 1 findings / 2 usage).
+"""
+from __future__ import annotations
+
+from arbius_tpu.analysis.baseline import Baseline
+from arbius_tpu.analysis.core import (
+    RULES,
+    AnalysisError,
+    FileContext,
+    Finding,
+    analyze_paths,
+    analyze_source,
+    load_builtin_rules,
+    rule,
+)
+from arbius_tpu.analysis.directives import FileDirectives, parse_directives
+
+load_builtin_rules()
+
+__all__ = [
+    "RULES", "AnalysisError", "Baseline", "FileContext", "FileDirectives",
+    "Finding", "analyze_paths", "analyze_source", "load_builtin_rules",
+    "parse_directives", "rule",
+]

@@ -1,0 +1,109 @@
+"""detlint baseline — checked-in register of intentional impurities.
+
+The baseline is the reviewed list of findings the tree is allowed to
+keep: obs wall-clock timestamps, devnet server plumbing, boot-time
+jax.config mutation. Everything else must be fixed or carry an inline
+pragma. Entries match on **(path, rule, snippet)** — the stripped
+source line, not the line number — so unrelated edits above a finding
+don't invalidate the baseline; `count` bounds how many identical
+occurrences one entry may absorb (a copy-pasted second `time.time()`
+on a new line with the same text still fails the build).
+
+`update()` regenerates the file deterministically (sorted keys, sorted
+entries, `\n` line ends) and carries reasons forward, so
+`--baseline-update` produces zero spurious diff when nothing changed.
+
+Findings whose file `enforce[]`s their rule are never baselined and
+never matched — see directives.py.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from arbius_tpu.analysis.core import Finding
+
+UNREVIEWED = "UNREVIEWED — justify this entry or fix the finding"
+
+
+@dataclass(frozen=True)
+class BaselineKey:
+    path: str
+    rule: str
+    snippet: str
+
+
+class Baseline:
+    def __init__(self, entries: dict[BaselineKey, dict] | None = None):
+        # entry: {"count": int, "reason": str}
+        self.entries = entries or {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        entries = {}
+        for e in doc.get("findings", []):
+            key = BaselineKey(e["path"], e["rule"], e["snippet"])
+            entries[key] = {"count": int(e.get("count", 1)),
+                            "reason": e.get("reason", "")}
+        return cls(entries)
+
+    def apply(self, findings: list[Finding]) -> list[Finding]:
+        """Return the findings NOT absorbed by the baseline."""
+        budget = {k: v["count"] for k, v in self.entries.items()}
+        out = []
+        for f in findings:
+            key = BaselineKey(f.path, f.rule, f.snippet)
+            if not f.enforced and budget.get(key, 0) > 0:
+                budget[key] -= 1
+                continue
+            out.append(f)
+        return out
+
+    def to_document(self) -> dict:
+        findings = []
+        for key in sorted(self.entries,
+                          key=lambda k: (k.path, k.rule, k.snippet)):
+            e = self.entries[key]
+            findings.append({"path": key.path, "rule": key.rule,
+                             "snippet": key.snippet, "count": e["count"],
+                             "reason": e["reason"]})
+        return {"version": 1, "findings": findings}
+
+    def dump(self, path: str) -> None:
+        doc = self.to_document()
+        with open(path, "w", encoding="utf-8", newline="\n") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+
+def update(findings: list[Finding], previous: Baseline | None,
+           analyzed_paths: set[str] | None = None) -> Baseline:
+    """Build a fresh baseline from the current findings, keeping reasons
+    for keys that already existed. Enforced findings are excluded — they
+    must be fixed, a regenerated baseline cannot launder them.
+
+    `analyzed_paths` is the set of file paths this run actually scanned:
+    previous entries for files OUTSIDE it are carried over untouched, so
+    a partial run (`detlint node/ --baseline-update`) refreshes only its
+    own slice instead of silently deleting every other reviewed entry."""
+    counts: dict[BaselineKey, int] = {}
+    for f in findings:
+        if f.enforced:
+            continue
+        key = BaselineKey(f.path, f.rule, f.snippet)
+        counts[key] = counts.get(key, 0) + 1
+    entries = {}
+    if previous is not None and analyzed_paths is not None:
+        for key, e in previous.entries.items():
+            if key.path not in analyzed_paths:
+                entries[key] = dict(e)
+    for key, n in counts.items():
+        reason = UNREVIEWED
+        if previous is not None and key in previous.entries:
+            prev_reason = previous.entries[key]["reason"]
+            if prev_reason:
+                reason = prev_reason
+        entries[key] = {"count": n, "reason": reason}
+    return Baseline(entries)

@@ -1,0 +1,149 @@
+"""detlint command line — `python -m arbius_tpu.analysis` / tools/detlint.py.
+
+Exit codes (pre-commit / CI contract):
+
+    0   clean (every finding fixed, suppressed, or baselined)
+    1   findings
+    2   usage error (bad path, unknown rule, unreadable baseline)
+
+`--baseline-update` regenerates the baseline file deterministically
+(sorted entries, reasons carried forward) and exits 0; a freshly
+regenerated baseline never absorbs `enforce[]`d findings.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from arbius_tpu.analysis import baseline as baseline_mod
+from arbius_tpu.analysis.core import (
+    RULES,
+    AnalysisError,
+    analyze_tree,
+    load_builtin_rules,
+)
+
+DEFAULT_BASELINE = "detlint-baseline.json"
+
+
+def build_arg_parser(p: argparse.ArgumentParser | None = None
+                     ) -> argparse.ArgumentParser:
+    """Populate `p` (or a fresh parser) with the detlint arguments —
+    tools/detlint.py builds its parser through tools/_common.py and
+    passes it here, so tool and module stay argument-identical."""
+    if p is None:
+        p = argparse.ArgumentParser(
+            prog="detlint", description=__doc__,
+            formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*", default=["arbius_tpu"],
+                   help="files/directories to analyze (default: arbius_tpu)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (stable: findings sorted "
+                        "by path/line/col/rule, keys sorted)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help=f"baseline file (default: {DEFAULT_BASELINE}; "
+                        "missing file = empty baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings too")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="rewrite the baseline from the current findings "
+                        "and exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--root", default=".",
+                   help="paths in output/baseline are relative to this "
+                        "(default: cwd)")
+    return p
+
+
+def collect(ns: argparse.Namespace):
+    """Analyze per the parsed args and apply the baseline (or rewrite it
+    for --baseline-update). Returns (exit_code, findings); a non-None
+    exit code short-circuits (usage error or baseline-update done) —
+    tools/detlint.py shares this so tool and module agree exactly."""
+    load_builtin_rules()
+    select = None
+    if ns.select:
+        if ns.baseline_update:
+            # a rule-filtered run sees only a slice of the findings — a
+            # baseline rebuilt from it would delete every other entry
+            print("detlint: --baseline-update cannot be combined with "
+                  "--select (it would drop entries for unselected rules)",
+                  file=sys.stderr)
+            return 2, []
+        select = {r.strip() for r in ns.select.split(",") if r.strip()}
+        unknown = select - set(RULES) - {"LINT001", "LINT002"}
+        if unknown:
+            print(f"detlint: unknown rule id(s): "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2, []
+    try:
+        findings, analyzed = analyze_tree(list(ns.paths), root=ns.root,
+                                          select=select)
+    except AnalysisError as e:
+        print(f"detlint: {e}", file=sys.stderr)
+        return 2, []
+
+    prev = None
+    try:
+        prev = baseline_mod.Baseline.load(ns.baseline)
+    except FileNotFoundError:
+        prev = None
+    except (OSError, ValueError, KeyError) as e:
+        print(f"detlint: unreadable baseline {ns.baseline}: {e}",
+              file=sys.stderr)
+        return 2, []
+
+    if ns.baseline_update:
+        baseline_mod.update(findings, prev,
+                            analyzed_paths=analyzed).dump(ns.baseline)
+        kept = [f for f in findings if f.enforced]
+        print(f"detlint: baseline written to {ns.baseline} "
+              f"({len(findings) - len(kept)} finding(s) recorded)",
+              file=sys.stderr)
+        for f in kept:
+            print(f.text() + "  [enforced — cannot be baselined]",
+                  file=sys.stderr)
+        return (1 if kept else 0), kept
+
+    if prev is not None and not ns.no_baseline:
+        findings = prev.apply(findings)
+    return None, findings
+
+
+def render(ns: argparse.Namespace, findings, out) -> None:
+    """The one definition of the report format — `python -m
+    arbius_tpu.analysis` and tools/detlint.py both emit exactly this."""
+    if ns.json:
+        doc = {"version": 1,
+               "findings": [f.to_json() for f in findings]}
+        out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    else:
+        for f in findings:
+            out.write(f.text() + "\n")
+        if findings:
+            out.write(f"detlint: {len(findings)} finding(s)\n")
+
+
+def run(ns: argparse.Namespace, out=None) -> int:
+    out = out or sys.stdout
+    rc, findings = collect(ns)
+    if rc is not None:
+        return rc
+    render(ns, findings, out)
+    return 1 if findings else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_arg_parser()
+    try:
+        ns = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage error, 0 on --help — preserve both
+        return int(e.code or 0)
+    return run(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,364 @@
+"""detlint core — findings, the rule registry, and the per-file driver.
+
+A *rule* is a function `(FileContext) -> Iterable[Finding]` registered
+under a stable id (`DET101`, `JIT201`, …) with `@rule(...)`. The driver
+parses each file once, precomputes the shared facts every rule family
+needs (AST parent links, dotted-name resolution, the set of
+jit-compiled function bodies), runs every registered rule, and then
+applies the two escape hatches:
+
+  - inline suppressions — `# detlint: allow[RULE] reason` on the
+    finding's line or the line above (directives.py);
+  - the checked-in baseline — intentional impurities recorded with a
+    reason (baseline.py), matched by (path, rule, source snippet) so
+    entries survive unrelated line drift.
+
+Files may also declare `# detlint: enforce[RULE,...]` — findings for
+those rules in that file can NEITHER be suppressed NOR baselined. The
+solve→encode→CID modules use this so a wall-clock or RNG call there is
+always fatal, even to a stale baseline (ISSUE: guards against rule rot).
+
+Everything is deterministic by construction: findings sort by
+(path, line, col, rule) and no rule may read wall time, environment, or
+filesystem order (detlint lints itself in the tier-1 self-check).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import tokenize
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator
+
+from arbius_tpu.analysis.directives import FileDirectives, parse_directives
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One diagnostic: where, which rule, and why it matters."""
+
+    path: str        # posix-style path relative to the analysis root
+    line: int        # 1-based
+    col: int         # 0-based (ast convention)
+    rule: str
+    severity: str
+    message: str
+    snippet: str     # stripped source line — the baseline match key
+    enforced: bool = False  # enforce[] directive: cannot be waived
+
+    def to_json(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "severity": self.severity,
+                "message": self.message, "snippet": self.snippet,
+                "enforced": self.enforced}
+
+    def text(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity}] {self.message}")
+
+
+@dataclass
+class Rule:
+    id: str
+    severity: str
+    summary: str
+    check: Callable[["FileContext"], Iterable[tuple[int, int, str]]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, severity: str, summary: str):
+    """Register a rule. The decorated function yields (line, col, message)
+    tuples; the driver wraps them into Findings."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"bad severity {severity!r} for {rule_id}")
+
+    def deco(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id}")
+        RULES[rule_id] = Rule(rule_id, severity, summary, fn)
+        return fn
+
+    return deco
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`time.time` / `jax.random.PRNGKey` → its dotted string; None for
+    anything that is not a plain Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name → canonical dotted prefix, from the file's imports.
+
+    `import time as _t` → {_t: time}; `from time import time` →
+    {time: time.time}; `from numpy import random as r` →
+    {r: numpy.random}. Aliased and from-imports are how a wall-clock
+    call would otherwise slip past literal name matching — the rules
+    match CANONICAL names (see FileContext.canonical)."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                # plain `import x.y` binds `x`, which already IS canonical
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+class FileContext:
+    """Parsed file + the precomputed facts rules share."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 directives: FileDirectives):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.directives = directives
+        self.parents: dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[id(child)] = parent
+        self.aliases = _import_aliases(tree)
+        self.jit_functions = _collect_jit_functions(tree, self.aliases)
+
+    def canonical(self, node: ast.AST) -> str | None:
+        """dotted_name with the file's import aliases resolved:
+        `_t.time` → `time.time`, bare `time` after `from time import
+        time` → `time.time`, `np.random.rand` → `numpy.random.rand`.
+        This is what rules must match on — literal spelling is evadable
+        by a one-line import alias."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        mapped = self.aliases.get(head)
+        if mapped is None:
+            return name
+        return f"{mapped}.{rest}" if rest else mapped
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(id(cur))
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def inside_call_to(self, node: ast.AST, names: tuple[str, ...]) -> bool:
+        """Is `node` (transitively) an argument of a call to one of
+        `names`? Used to accept `sorted(p for p in x.iterdir())`."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.Call):
+                fn = dotted_name(anc.func)
+                if fn in names:
+                    return True
+        return False
+
+
+_JIT_SUFFIXES = ("jit", "pjit")
+
+
+def _is_jit_callable(node: ast.AST, aliases: dict[str, str]) -> bool:
+    name = dotted_name(node)
+    if name is None:
+        return False
+    head, _, rest = name.partition(".")
+    mapped = aliases.get(head)
+    if mapped is not None:
+        name = f"{mapped}.{rest}" if rest else mapped
+    last = name.rsplit(".", 1)[-1]
+    return last in _JIT_SUFFIXES
+
+
+def _collect_jit_functions(tree: ast.Module,
+                           aliases: dict[str, str]) -> list[ast.AST]:
+    """Function bodies that end up traced by jax.jit / pjit.
+
+    Three shapes are recognized, matching how this repo (and JAX code
+    generally) spells compilation:
+
+      @jax.jit / @pjit / @partial(jax.jit, ...)   decorated defs
+      jax.jit(fn)(...) / jax.jit(wrap(fn, ...))   defs referenced by
+                                                  name inside the
+                                                  FIRST argument of a
+                                                  jit(...) call
+      jax.jit(lambda ...: ...)                    lambdas there
+
+    Only the first positional argument is searched — that is the
+    function being compiled; names in later args (static config,
+    dtypes) are not traced and flagging them would poison enforce[]'d
+    files with un-waivable false positives.
+    """
+    jit_fns: list[ast.AST] = []
+    referenced: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_callable(dec, aliases):
+                    jit_fns.append(node)
+                elif isinstance(dec, ast.Call):
+                    # @partial(jax.jit, ...) or @jax.jit with kwargs
+                    if _is_jit_callable(dec.func, aliases) or any(
+                            _is_jit_callable(a, aliases)
+                            for a in dec.args):
+                        jit_fns.append(node)
+        elif isinstance(node, ast.Call) and \
+                _is_jit_callable(node.func, aliases) and node.args:
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, ast.Name):
+                    referenced.add(sub.id)
+                elif isinstance(sub, ast.Lambda):
+                    jit_fns.append(sub)
+    if referenced:
+        already = {id(f) for f in jit_fns}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name in referenced and id(node) not in already:
+                jit_fns.append(node)
+    return jit_fns
+
+
+class AnalysisError(Exception):
+    """A file could not be read/parsed (reported, never swallowed)."""
+
+
+def analyze_source(source: str, relpath: str,
+                   select: set[str] | None = None) -> list[Finding]:
+    """Run every (selected) rule over one file's source. Returns raw
+    findings — suppressions applied, baseline NOT applied."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        raise AnalysisError(f"{relpath}: syntax error: {e}") from e
+    directives = parse_directives(source)
+    ctx = FileContext(relpath, source, tree, directives)
+    findings: list[Finding] = []
+    for rid in sorted(RULES):
+        if select is not None and rid not in select:
+            continue
+        r = RULES[rid]
+        for line, col, message in r.check(ctx):
+            enforced = rid in directives.enforced
+            if not enforced and directives.is_allowed(rid, line):
+                continue
+            findings.append(Finding(
+                path=relpath, line=line, col=col, rule=rid,
+                severity=r.severity, message=message,
+                snippet=ctx.snippet(line), enforced=enforced))
+    # LINT001/LINT002 are structural (directive hygiene), not AST-based
+    if select is None or "LINT001" in select:
+        for line, reason in directives.missing_reasons():
+            findings.append(Finding(
+                path=relpath, line=line, col=0, rule="LINT001",
+                severity="warning",
+                message="suppression without a reason — "
+                        "`# detlint: allow[RULE] why it is safe`",
+                snippet=ctx.snippet(line)))
+    if select is None or "LINT002" in select:
+        known = set(RULES) | {"LINT001", "LINT002", "*"}
+        for line, rid in directives.named_rules:
+            if rid not in known:
+                findings.append(Finding(
+                    path=relpath, line=line, col=0, rule="LINT002",
+                    severity="error",
+                    message=f"unknown rule id `{rid}` in directive — a "
+                            "typo here silently voids the allow/enforce "
+                            "it was meant to apply",
+                    snippet=ctx.snippet(line)))
+    findings.sort()
+    return findings
+
+
+def iter_python_files(paths: list[str], root: str) -> Iterator[tuple[str, str]]:
+    """Yield (abspath, relpath) for every .py under `paths`, sorted —
+    filesystem enumeration order must never reach the report."""
+    seen: set[str] = set()
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap):
+            if not ap.endswith(".py"):
+                # silently skipping an explicitly named file would make
+                # a mistyped pre-commit path report "clean" forever
+                raise AnalysisError(f"not a .py file: {p}")
+            files = [ap]
+        elif os.path.isdir(ap):
+            files = []
+            # detlint: allow[DET103] dirnames/filenames are sorted in
+            # place below — the traversal order is pinned
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d != "__pycache__")
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(filenames)
+                             if f.endswith(".py"))
+        else:
+            raise AnalysisError(f"no such file or directory: {p}")
+        for f in sorted(files):
+            if f in seen:
+                continue
+            seen.add(f)
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            yield f, rel
+
+
+def analyze_tree(paths: list[str], root: str | None = None,
+                 select: set[str] | None = None
+                 ) -> tuple[list[Finding], set[str]]:
+    """Analyze every .py file under `paths`; returns (findings sorted by
+    (path, line, col, rule) for byte-stable output, the set of relpaths
+    scanned — from the same single traversal, so a partial
+    --baseline-update knows exactly which files it may refresh)."""
+    root = os.path.abspath(root or os.getcwd())
+    findings: list[Finding] = []
+    analyzed: set[str] = set()
+    for abspath, relpath in iter_python_files(paths, root):
+        try:
+            # tokenize.open honors PEP 263 coding declarations
+            with tokenize.open(abspath) as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError, SyntaxError) as e:
+            # tool failure is the usage exit (2), never the findings
+            # exit (1) — CI must distinguish "dirty" from "broken"
+            raise AnalysisError(f"{relpath}: unreadable: {e}") from e
+        analyzed.add(relpath)
+        findings.extend(analyze_source(source, relpath, select=select))
+    findings.sort()
+    return findings, analyzed
+
+
+def analyze_paths(paths: list[str], root: str | None = None,
+                  select: set[str] | None = None) -> list[Finding]:
+    return analyze_tree(paths, root=root, select=select)[0]
+
+
+# registration side effects: importing the families populates RULES
+def load_builtin_rules() -> None:
+    from arbius_tpu.analysis import (  # noqa: F401
+        rules_concurrency,
+        rules_determinism,
+        rules_jit,
+    )

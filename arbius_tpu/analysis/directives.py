@@ -1,0 +1,142 @@
+"""detlint inline directives — `# detlint: allow[...]` / `enforce[...]`.
+
+Grammar (one directive per comment):
+
+    # detlint: allow[DET101] obs wall timestamp, never hashed
+    # detlint: allow[DET101,DET102] reason covering both
+    # detlint: enforce[DET101,DET102]   (module-level, anywhere in file)
+
+`allow` waives matching findings on the statement it trails — the whole
+logical line, so a pragma at the end of a multi-line call still covers
+the expression's first physical line, where findings anchor — or, when
+the comment stands alone, on the next code line (reasons may wrap onto
+continuation comment lines). A reason is required; an allow with no
+reason waives nothing and is itself reported as LINT001.
+
+`enforce` marks rule ids that can never be waived in this file, by
+pragma or baseline. It is how the solve-path modules pin themselves
+clean (node/solver.py, node/retry.py).
+
+Rule ids in either directive are validated against the registry by the
+driver (core.analyze_source): an unknown id is reported as LINT002 —
+a typo in an enforce list must never silently void the guarantee.
+
+Comments are found with `tokenize`, not a line regex, so directive-
+looking text inside string literals is ignored.
+"""
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+_DIRECTIVE = re.compile(
+    r"detlint:\s*(?P<verb>allow|enforce)\[(?P<ids>[^\]]*)\]\s*(?P<reason>.*)")
+
+_SKIP_TOKENS = frozenset((
+    tokenize.COMMENT, tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+    tokenize.DEDENT, tokenize.ENCODING, tokenize.ENDMARKER,
+))
+
+
+@dataclass
+class Allow:
+    first_line: int      # directive covers lines [first_line, last_line]
+    last_line: int
+    rules: tuple[str, ...]
+    reason: str
+    directive_line: int  # line the comment physically sits on
+
+    def covers(self, line: int) -> bool:
+        return self.first_line <= line <= self.last_line
+
+
+@dataclass
+class FileDirectives:
+    allows: list[Allow] = field(default_factory=list)
+    enforced: set[str] = field(default_factory=set)
+    # (line, id) of every rule id named in any directive, for validation
+    named_rules: list[tuple[int, str]] = field(default_factory=list)
+
+    def is_allowed(self, rule_id: str, line: int) -> bool:
+        for a in self.allows:
+            if a.covers(line) and a.reason and \
+                    (rule_id in a.rules or "*" in a.rules):
+                return True
+        return False
+
+    def missing_reasons(self) -> list[tuple[int, str]]:
+        return sorted((a.directive_line, ",".join(a.rules))
+                      for a in self.allows if not a.reason)
+
+
+def parse_directives(source: str) -> FileDirectives:
+    out = FileDirectives()
+    lines = source.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except tokenize.TokenizeError:
+        return out
+    # logical-line spans (first physical row → NEWLINE row), so a pragma
+    # covers the WHOLE wrapped statement: findings may anchor on any
+    # physical line of it (the outer call's first line, a nested call's
+    # continuation line, ...)
+    spans: list[tuple[int, int]] = []
+    logical_start: int | None = None
+    comments: list[tuple[tokenize.TokenInfo, int | None]] = []
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            comments.append((tok, logical_start))
+        elif tok.type == tokenize.NEWLINE:
+            if logical_start is not None:
+                spans.append((logical_start, tok.start[0]))
+            logical_start = None
+        elif tok.type not in _SKIP_TOKENS:
+            if logical_start is None:
+                logical_start = tok.start[0]
+
+    def span_containing(row: int) -> tuple[int, int]:
+        for lo, hi in spans:
+            if lo <= row <= hi:
+                return lo, hi
+        return row, row
+
+    def span_after(row: int) -> tuple[int, int]:
+        for lo, hi in spans:
+            if lo > row:
+                return lo, hi
+        return row + 1, row + 1
+    for tok, stmt_start in comments:
+        m = _DIRECTIVE.search(tok.string)
+        if m is None:
+            continue
+        ids = tuple(sorted(i.strip() for i in m.group("ids").split(",")
+                           if i.strip()))
+        if not ids:
+            continue
+        row = tok.start[0]
+        out.named_rules.extend((row, i) for i in ids)
+        if m.group("verb") == "enforce":
+            out.enforced.update(ids)
+            continue
+        before = lines[row - 1][:tok.start[1]] if row <= len(lines) else ""
+        if before.strip():
+            # trailing a statement: cover its whole logical line — a
+            # finding may anchor on ANY physical line of the wrapped
+            # statement, not just where the pragma sits
+            first, last = span_containing(stmt_start or row)
+        elif stmt_start is not None:
+            # own-line comment INSIDE a bracketed statement → that
+            # statement (e.g. a pragma above one entry of a wrapped
+            # dict literal)
+            first, last = span_containing(stmt_start)
+        else:
+            # standalone comment → covers the next logical statement in
+            # full (reasons may wrap onto continuation comment lines)
+            first, last = span_after(row)
+        out.allows.append(Allow(first_line=first, last_line=last,
+                                rules=ids,
+                                reason=m.group("reason").strip(),
+                                directive_line=row))
+    return out

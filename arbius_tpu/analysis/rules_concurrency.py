@@ -1,0 +1,156 @@
+"""detlint concurrency rules (CONC3xx).
+
+The node runs real threads: ControlRPC serves from a ThreadingHTTPServer,
+the devnet's handler threads apply transactions, Heartbeat reports from
+a daemon thread (node/, chain/devnet.py, utils/session.py). A shared
+attribute written by the event loop and read by a thread target without
+a lock is a data race the tests will basically never catch — the GIL
+makes it *rarely* visible, not correct.
+
+  CONC301  an attribute is written in one method and accessed from a
+           `threading.Thread(target=self.<m>)` body (or vice versa)
+           with neither side holding a lock
+
+Heuristics that keep the rule honest:
+
+  - only classes that actually start a thread on one of their own
+    methods are analyzed;
+  - attributes assigned a threading primitive (Lock/Event/Condition/
+    Thread/Queue) are exempt — their methods are the synchronization;
+  - `__init__` writes are exempt (they happen-before `Thread.start()`);
+  - an access lexically inside `with self.<anything containing "lock">:`
+    counts as held.
+"""
+from __future__ import annotations
+
+import ast
+
+from arbius_tpu.analysis.core import FileContext, dotted_name, rule
+
+_SYNC_SUFFIXES = ("Lock", "RLock", "Event", "Condition", "Semaphore",
+                  "BoundedSemaphore", "Barrier", "Thread", "Queue",
+                  "SimpleQueue", "local")
+
+
+def _is_sync_primitive(ctx: "FileContext", value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    name = ctx.canonical(value.func)
+    return name is not None and name.endswith(_SYNC_SUFFIXES)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _under_lock(ctx: FileContext, node: ast.AST) -> bool:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                name = dotted_name(expr) or ""
+                if "lock" in name.lower():
+                    return True
+    return False
+
+
+class _ClassFacts:
+    def __init__(self, ctx: FileContext, cls: ast.ClassDef):
+        self.methods: dict[str, ast.FunctionDef] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.thread_targets: set[str] = set()
+        self.sync_attrs: set[str] = set()
+        self.calls: dict[str, set[str]] = {m: set() for m in self.methods}
+        # writes/reads: attr -> list of (method, line, locked)
+        self.writes: dict[str, list] = {}
+        self.reads: dict[str, list] = {}
+        for mname, m in self.methods.items():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Call):
+                    fname = ctx.canonical(node.func)
+                    if fname is not None and (
+                            fname == "Thread"
+                            or fname.endswith("threading.Thread")):
+                        for kw in node.keywords:
+                            if kw.arg != "target":
+                                continue
+                            attr = _self_attr(kw.value)
+                            if attr in self.methods:
+                                self.thread_targets.add(attr)
+                    callee = _self_attr(node.func)
+                    if callee in self.methods:
+                        self.calls[mname].add(callee)
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    value = getattr(node, "value", None)
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        if value is not None and \
+                                _is_sync_primitive(ctx, value):
+                            self.sync_attrs.add(attr)
+                            continue
+                        self.writes.setdefault(attr, []).append(
+                            (mname, t.lineno, _under_lock(ctx, t)))
+                attr = _self_attr(node)
+                if attr is not None and \
+                        isinstance(getattr(node, "ctx", None), ast.Load):
+                    self.reads.setdefault(attr, []).append(
+                        (mname, node.lineno, _under_lock(ctx, node)))
+
+    def reachable_from_targets(self) -> set[str]:
+        seen: set[str] = set()
+        stack = list(self.thread_targets)
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(self.calls.get(m, ()))
+        return seen
+
+
+@rule("CONC301", "warning",
+      "attribute shared between a thread target and other methods "
+      "without a lock")
+def unlocked_shared_attribute(ctx: FileContext):
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        facts = _ClassFacts(ctx, cls)
+        if not facts.thread_targets:
+            continue
+        in_thread = facts.reachable_from_targets()
+        attrs = sorted((set(facts.writes) | set(facts.reads))
+                       - facts.sync_attrs)
+        for attr in attrs:
+            writes = facts.writes.get(attr, [])
+            reads = facts.reads.get(attr, [])
+            # __init__ happens-before Thread.start(): neither its writes
+            # nor its reads can race the thread
+            live_writes = [w for w in writes if w[0] != "__init__"]
+            live_reads = [r for r in reads if r[0] != "__init__"]
+            side = lambda m: m in in_thread  # noqa: E731
+            for wmethod, wline, wlocked in live_writes:
+                other = [a for a in live_writes + live_reads
+                         if side(a[0]) != side(wmethod)]
+                if not other:
+                    continue
+                if wlocked and all(a[2] for a in other):
+                    continue
+                tgt = ", ".join(sorted(facts.thread_targets))
+                yield (wline, 0,
+                       f"`self.{attr}` is written in `{cls.name}."
+                       f"{wmethod}` and shared with thread target "
+                       f"`{tgt}` without a held lock — GIL scheduling "
+                       "decides who wins")
+                break  # one finding per attribute

@@ -1,0 +1,208 @@
+"""detlint determinism rules (DET1xx).
+
+The protocol never re-executes a solve on-chain: the committed CID is
+the *only* evidence, so any host-side impurity on the
+solve→encode→CID path (docs/determinism.md) silently forks honest
+miners into different determinism classes. These rules catch the
+impurity sources that have actually bitten TPU inference stacks:
+
+  DET101  wall-clock reads         time.time / perf_counter / datetime.now
+  DET102  unseeded / OS-entropy    random.*, np.random.*, os.urandom,
+          RNG                      secrets.*, uuid1/uuid4
+  DET103  filesystem-order         os.listdir / glob / Path.iterdir
+          iteration                not wrapped in sorted()
+  DET104  unsorted serialization   json.dumps(obj) without sort_keys=True
+                                   (dict literals with constant keys are
+                                   insertion-ordered and exempt)
+  DET105  set iteration            for/comprehension over a set — order
+                                   follows PYTHONHASHSEED, not the data
+  DET106  runtime numeric-env      jax.config.update / os.environ
+          mutation                 writes inside a function body
+
+jax.random is deliberately NOT flagged: its streams are explicitly
+keyed (PRNGKey(seed) + fold_in), which is the sanctioned determinism
+mechanism here.
+"""
+from __future__ import annotations
+
+import ast
+
+from arbius_tpu.analysis.core import FileContext, dotted_name, rule
+
+_WALL_CLOCK_EXACT = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.clock_gettime",
+}
+_WALL_CLOCK_SUFFIX = (
+    "datetime.now", "datetime.utcnow", "datetime.today", "date.today",
+)
+
+_RNG_EXACT = {
+    "os.urandom", "uuid.uuid1", "uuid.uuid4", "os.getrandom",
+}
+_RNG_PREFIX = ("secrets.", "random.", "np.random.", "numpy.random.")
+_RNG_SEEDED_OK = {"default_rng", "Generator", "SeedSequence", "PRNGKey",
+                  "seed", "Random"}
+# deterministic members of otherwise-RNG modules — flagging these would
+# make e.g. a constant-time digest compare un-waivable in enforced files
+_RNG_EXCLUDE = {"secrets.compare_digest", "random.getstate",
+                "random.setstate"}
+
+_FS_EXACT = {"os.listdir", "os.scandir", "os.walk",
+             "glob.glob", "glob.iglob"}
+_FS_METHODS = {"iterdir", "glob", "rglob"}
+
+
+@rule("DET101", "error",
+      "wall-clock read — nondeterministic across runs and hosts")
+def wall_clock(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.canonical(node.func)
+        if name is None:
+            continue
+        if name in _WALL_CLOCK_EXACT or any(
+                name == s or name.endswith("." + s)
+                for s in _WALL_CLOCK_SUFFIX):
+            yield (node.lineno, node.col_offset,
+                   f"wall-clock read `{name}()` — a deterministic path "
+                   "must take time from the chain facade or a seeded input")
+
+
+@rule("DET102", "error",
+      "unseeded or OS-entropy RNG — breaks bit-reproducibility")
+def host_rng(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.canonical(node.func)
+        if name is None:
+            continue
+        flagged = name in _RNG_EXACT
+        if not flagged and name not in _RNG_EXCLUDE:
+            for prefix in _RNG_PREFIX:
+                if name.startswith(prefix):
+                    last = name.rsplit(".", 1)[-1]
+                    # seeded constructors with an explicit seed arg are
+                    # the fix, not the bug
+                    if last in _RNG_SEEDED_OK and (node.args
+                                                   or node.keywords):
+                        break
+                    flagged = True
+                    break
+        if flagged:
+            yield (node.lineno, node.col_offset,
+                   f"host RNG `{name}()` — solve-path randomness must "
+                   "come from jax.random keyed by the task seed")
+
+
+@rule("DET103", "error",
+      "filesystem-order iteration — listdir/glob order is not stable")
+def fs_order(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.canonical(node.func)
+        hit = None
+        if name in _FS_EXACT:
+            hit = name
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _FS_METHODS:
+            # any .iterdir()/.glob()/.rglob() method call — including on
+            # expressions dotted_name can't resolve, e.g.
+            # (root / "files").iterdir()
+            hit = name or node.func.attr
+        if hit is None:
+            continue
+        if ctx.inside_call_to(node, ("sorted",)):
+            continue
+        yield (node.lineno, node.col_offset,
+               f"filesystem enumeration `{hit}(...)` without sorted() — "
+               "directory order depends on the filesystem, not the data")
+
+
+@rule("DET104", "warning",
+      "json.dumps without sort_keys=True on a non-literal object")
+def unsorted_dumps(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.canonical(node.func)
+        if name is None or not (name == "json.dumps"
+                                or name.endswith(".json.dumps")):
+            continue
+        sk = next((kw.value for kw in node.keywords
+                   if kw.arg == "sort_keys"), None)
+        if sk is not None and not (isinstance(sk, ast.Constant)
+                                   and sk.value is False):
+            # a constant True (or a variable the caller vouches for)
+            # counts; an explicit sort_keys=False does not
+            continue
+        if node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Dict) and all(
+                    isinstance(k, ast.Constant) for k in arg.keys):
+                continue  # literal keys serialize in source order
+        yield (node.lineno, node.col_offset,
+               "json.dumps(...) without sort_keys=True — serialized key "
+               "order follows dict construction history; sort before "
+               "bytes feed hashes, wires, or goldens")
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in ("set", "frozenset")
+    return False
+
+
+@rule("DET105", "warning",
+      "iteration over a set — order follows string hashing, "
+      "randomized per process")
+def set_iteration(ctx: FileContext):
+    def flag(it: ast.AST):
+        if _is_set_expr(it) and not ctx.inside_call_to(it, ("sorted",)):
+            yield (it.lineno, it.col_offset,
+                   "iterating a set — wrap in sorted() before the order "
+                   "can reach hashes or serialized output")
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.For):
+            yield from flag(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                yield from flag(gen.iter)
+
+
+@rule("DET106", "warning",
+      "runtime mutation of numeric environment (jax.config / os.environ)")
+def runtime_env_mutation(ctx: FileContext):
+    func_spans = [n for n in ast.walk(ctx.tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    in_func = set()
+    for fn in func_spans:
+        for sub in ast.walk(fn):
+            in_func.add(id(sub))
+    for node in ast.walk(ctx.tree):
+        if id(node) not in in_func:
+            continue  # module-level configuration is boot-time, fine
+        if isinstance(node, ast.Call):
+            name = ctx.canonical(node.func)
+            if name is not None and name.endswith("config.update"):
+                yield (node.lineno, node.col_offset,
+                       f"`{name}(...)` inside a function — float/x64/"
+                       "platform flags change XLA program identity and "
+                       "must be fixed before any solve compiles")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        ctx.canonical(t.value) == "os.environ":
+                    yield (t.lineno, t.col_offset,
+                           "os.environ[...] write inside a function — "
+                           "env that alters compiled programs must be "
+                           "set at process boot")

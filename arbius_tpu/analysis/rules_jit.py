@@ -1,0 +1,91 @@
+"""detlint jit-purity rules (JIT2xx).
+
+A function handed to `jax.jit`/`pjit` is traced once and replayed as an
+XLA program; host-side escapes inside it either crash on tracers
+(`float(tracer)`), silently bake one traced value into every replay
+(`np.asarray`, `.item()`), or fire at trace time instead of run time
+(`print`, global mutation). The TPU compilation papers this repo
+reproduces (arxiv 2008.01040, 1810.09868) lean on whole-graph analysis
+precisely because these impurities are invisible at runtime — the
+program runs, the bytes are wrong.
+
+  JIT201  host escape inside a jit function: .item()/.tolist()/
+          .block_until_ready(), np.asarray/np.array, print,
+          float()/int()/bool() on a non-literal
+  JIT202  global / nonlocal mutation inside a jit function
+
+Which functions count as jit-compiled is decided by core.py
+(`_collect_jit_functions`): decorated defs, defs referenced by name
+inside a jit(...) call (the `jax.jit(with_cast(_init, dtype))` idiom),
+and lambdas passed directly.
+"""
+from __future__ import annotations
+
+import ast
+
+from arbius_tpu.analysis.core import FileContext, dotted_name, rule
+
+_HOST_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _body_nodes(fn: ast.AST):
+    """All nodes inside a function body, excluding the def line itself
+    (decorators/defaults evaluate outside the traced scope)."""
+    body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+    for stmt in body:
+        yield from ast.walk(stmt)
+
+
+@rule("JIT201", "error",
+      "host escape inside a jit-compiled function")
+def host_escape_in_jit(ctx: FileContext):
+    seen: set[tuple[int, int]] = set()
+    for fn in ctx.jit_functions:
+        for node in _body_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            name = ctx.canonical(node.func)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _HOST_METHODS:
+                msg = (f"`.{node.func.attr}()` inside a jit function "
+                       "forces a device sync at trace time — the traced "
+                       "value is baked into every replay")
+            elif name in _HOST_CALLS:
+                msg = (f"`{name}(...)` inside a jit function pulls the "
+                       "tracer to host — use jnp, or move the cast "
+                       "outside the compiled scope")
+            elif name == "print":
+                msg = ("`print` inside a jit function fires at trace "
+                       "time only — use jax.debug.print or hoist it")
+            elif name in _CAST_BUILTINS and node.args and not isinstance(
+                    node.args[0], ast.Constant):
+                msg = (f"`{name}(...)` on a traced value raises "
+                       "ConcretizationError (or silently freezes a "
+                       "python scalar) — keep arithmetic in jnp")
+            if msg is not None:
+                key = (node.lineno, node.col_offset)
+                if key not in seen:
+                    seen.add(key)
+                    yield (node.lineno, node.col_offset, msg)
+
+
+@rule("JIT202", "error",
+      "global/nonlocal mutation inside a jit-compiled function")
+def global_mutation_in_jit(ctx: FileContext):
+    seen: set[tuple[int, int]] = set()
+    for fn in ctx.jit_functions:
+        for node in _body_nodes(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                key = (node.lineno, node.col_offset)
+                if key not in seen:
+                    seen.add(key)
+                    kind = "global" if isinstance(node, ast.Global) \
+                        else "nonlocal"
+                    yield (node.lineno, node.col_offset,
+                           f"`{kind} {', '.join(node.names)}` inside a "
+                           "jit function — mutation happens at trace "
+                           "time, not per call; thread state through "
+                           "arguments/returns instead")

@@ -563,7 +563,7 @@ class DevnetNode:
                 except Exception as e:  # noqa: BLE001 — malformed request
                     body = {"jsonrpc": "2.0", "id": req_id,
                             "error": {"code": -32600, "message": repr(e)}}
-                payload = json.dumps(body).encode()
+                payload = json.dumps(body, sort_keys=True).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))

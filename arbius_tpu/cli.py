@@ -152,7 +152,7 @@ def cmd_emission(args) -> int:
     if ts > 0 and t > 0:
         out["diffMul"] = diff_mul(t, ts) / WAD
         out["reward"] = reward(t, ts) / WAD
-    print(json.dumps(out))
+    print(json.dumps(out, sort_keys=True))
     return 0
 
 
@@ -411,6 +411,7 @@ def cmd_record_golden(args) -> int:
                        resolve_file=resolve_file).get(mid)
     hydrated = hydrate_input(dict(raw), m.template)
     platform = jax.devices()[0].platform
+    # detlint: allow[DET101] operator-facing elapsed_s; never hashed
     t0 = time.perf_counter()
     cid, _files = solve_cid(m, hydrated, args.seed)
     golden = {"input": raw, "seed": args.seed, "cid": cid}
@@ -422,6 +423,7 @@ def cmd_record_golden(args) -> int:
     print(json.dumps({
         "template": args.template, "platform": platform,
         "tiny": args.tiny, "weights_dtype": args.weights_dtype,
+        # detlint: allow[DET101] operator-facing elapsed_s; never hashed
         "elapsed_s": round(time.perf_counter() - t0, 1),
         "golden": golden,
     }))
@@ -553,7 +555,10 @@ def cmd_task_submit(args) -> int:
         from arbius_tpu.node.rpc_chain import RpcChain
 
         RpcChain(client, dep.token_address).ensure_fee_allowance(fee)
-    input_bytes = json.dumps(raw, separators=(",", ":")).encode()
+    # canonical form (sorted keys, tight separators) — the same bytes the
+    # node's POST /api/task path would submit for this input
+    input_bytes = json.dumps(raw, separators=(",", ":"),
+                             sort_keys=True).encode()
     if args.sign_only:
         # user-wallet dapp path (generate.tsx wagmi parity): sign here,
         # let the node forward the bytes via POST /api/tx/raw. Nonce/gas
@@ -605,7 +610,7 @@ def cmd_task_status(args) -> int:
                            "blocktime": sol.blocktime,
                            "claimed": sol.claimed,
                            "cid": "0x" + sol.cid.hex()}
-    print(json.dumps(out, indent=2))
+    print(json.dumps(out, indent=2, sort_keys=True))
     return 0
 
 

@@ -16,7 +16,13 @@ Two obs additions over the reference:
     / `arbius_retry_exhausted_total{op}`, journal kinds `retry` /
     `retry_exhausted`), so `GET /debug/journal` shows which call site is
     burning attempts and how much backoff it injected.
+
+The retry envelope wraps every solve-path chain/pin call, so the
+determinism rules below are enforced — a wall-clock read or host RNG
+added here (e.g. jitter) would skew every node differently and can
+never be pragma'd or baselined away (docs/static-analysis.md).
 """
+# detlint: enforce[DET101,DET102,DET105]
 from __future__ import annotations
 
 import time

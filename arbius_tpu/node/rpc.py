@@ -31,7 +31,7 @@ class ControlRPC:
                 pass
 
             def _send(self, code: int, payload):
-                body = json.dumps(payload).encode()
+                body = json.dumps(payload, sort_keys=True).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -223,7 +223,11 @@ class ControlRPC:
             raise ValueError("input must be an object")
         hydrate_input(dict(raw), m.template)  # reject before paying the fee
         fee = int(body.get("fee") or 0)  # str or int; wad > 2^53 arrives str
-        input_bytes = json.dumps(raw, separators=(",", ":")).encode()
+        # canonical form: sorted keys + tight separators, so semantically
+        # identical inputs submit identical bytes (and identical CIDs)
+        # regardless of the JSON key order the frontend happened to post
+        input_bytes = json.dumps(raw, separators=(",", ":"),
+                                 sort_keys=True).encode()
         self.node.chain.ensure_fee_allowance(fee)  # engine pulls the fee
         taskid = self.node.chain.submit_task(0, self.node.chain.address,
                                              model_id, fee, input_bytes)

@@ -21,8 +21,12 @@ import jax
 def enable_compile_cache(cache_dir: str) -> None:
     """Idempotent; safe before or after backend init."""
     os.makedirs(cache_dir, exist_ok=True)
+    # detlint: allow[DET106] boot-time compile-cache config — node.boot()
+    # runs this before any solve program compiles
     jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # detlint: allow[DET106] boot-time compile-cache config (see above)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # detlint: allow[DET106] boot-time compile-cache config (see above)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 

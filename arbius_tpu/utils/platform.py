@@ -48,8 +48,12 @@ def force_cpu_devices(n_devices: int, spare: tuple[str, ...] = ("cpu", "tpu"),
         flags = re.sub(r"--?xla_force_host_platform_device_count=\d+", opt, flags)
     else:
         flags = (flags + " " + opt).strip()
+    # detlint: allow[DET106] process-boot platform forcing — the
+    # already-initialized guard above makes a late call raise instead
     os.environ["XLA_FLAGS"] = flags
+    # detlint: allow[DET106] process-boot platform forcing (see above)
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # detlint: allow[DET106] process-boot platform forcing (see above)
     jax.config.update("jax_platforms", "cpu")
 
     if _xb is None:

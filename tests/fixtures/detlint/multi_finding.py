@@ -1,0 +1,40 @@
+"""detlint golden fixture — one file, many findings across families.
+
+tests/test_analysis.py analyzes this file and compares the JSON report
+byte-for-byte against multi_finding.golden.json. Every construct below
+is a deliberate violation; do not "fix" them.
+"""
+import glob
+import json
+import random
+import time
+
+import jax
+import numpy as np
+
+
+def stamp():
+    return {"at": time.time(), "nonce": random.random()}
+
+
+def scan(root):
+    out = []
+    for p in glob.glob(root + "/*.bin"):
+        out.append(p)
+    return out
+
+
+def serialize(obj):
+    return json.dumps(obj).encode()
+
+
+@jax.jit
+def bad_kernel(x):
+    print("tracing", x)
+    return np.asarray(x) + 1
+
+
+def pick(items):
+    for it in {"a", "b", "c"}:
+        items.append(it)
+    return items

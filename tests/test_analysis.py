@@ -1,0 +1,571 @@
+"""detlint — rule fixtures, escape hatches, golden JSON, and the tier-1
+package self-check that enforces the determinism invariant on every PR.
+
+Each rule gets positive + negative snippets; the suppression/baseline/
+enforce machinery gets its own section; the self-check runs the analyzer
+over the whole `arbius_tpu/` package against the checked-in baseline and
+fails on any non-baselined finding — which is the actual guardrail: add
+an unseeded RNG call or wall-clock read to the solve path and THIS file
+goes red.
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from arbius_tpu.analysis import (
+    Baseline,
+    Finding,
+    analyze_paths,
+    analyze_source,
+)
+from arbius_tpu.analysis import baseline as baseline_mod
+from arbius_tpu.analysis.cli import main as cli_main
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXDIR = pathlib.Path(__file__).parent / "fixtures" / "detlint"
+
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def rules_of(findings: list[Finding]) -> list[str]:
+    return [f.rule for f in findings]
+
+
+def check(source: str) -> list[Finding]:
+    return analyze_source(source, "snippet.py")
+
+
+# -- determinism rules ------------------------------------------------------
+
+def test_det101_wall_clock_positive_and_negative():
+    hits = check("import time\nt = time.time()\n")
+    assert rules_of(hits) == ["DET101"]
+    assert hits[0].line == 2
+    assert check("import datetime\nd = datetime.datetime.now()\n")
+    assert not check("t = chain.now\n")
+    assert not check("import time\ntime.sleep(1)\n")  # sleep reads nothing
+
+
+def test_det101_import_aliases_cannot_evade():
+    # `import time as _t` / `from time import time` must be caught —
+    # literal-spelling matching would let a one-line alias defeat the
+    # enforce[] guarantee (node/node.py already uses `import time as
+    # _time` style)
+    assert rules_of(check(
+        "import time as _t\nx = _t.time()\n")) == ["DET101"]
+    assert rules_of(check(
+        "from time import time\nx = time()\n")) == ["DET101"]
+    assert rules_of(check(
+        "from time import time as now\nx = now()\n")) == ["DET101"]
+    assert rules_of(check(
+        "from datetime import datetime\nd = datetime.now()\n")) == \
+        ["DET101"]
+
+
+def test_rule_aliases_across_families():
+    assert rules_of(check(
+        "from json import dumps\nb = dumps(obj)\n")) == ["DET104"]
+    assert rules_of(check(
+        "from os import urandom\nk = urandom(8)\n")) == ["DET102"]
+    assert rules_of(check(
+        "from glob import glob\nxs = glob('*.png')\n")) == ["DET103"]
+    src = ("from jax import jit\n"
+           "@jit\n"
+           "def f(x):\n    return x.item()\n")
+    assert rules_of(check(src)) == ["JIT201"]
+
+
+def test_det102_rng_positive_and_negative():
+    assert rules_of(check("import random\nx = random.random()\n")) == \
+        ["DET102"]
+    assert check("import os\nk = os.urandom(32)\n")
+    assert check("import numpy as np\nr = np.random.default_rng()\n")
+    # seeded constructors and keyed jax streams are the sanctioned path
+    assert not check("import numpy as np\nr = np.random.default_rng(7)\n")
+    assert not check("import jax\nk = jax.random.PRNGKey(seed)\n")
+
+
+def test_det101_suffix_needs_dot_boundary():
+    # a variable merely named *_datetime must not be a wall-clock hit
+    assert not check("x = my_datetime.now()\n")
+    assert rules_of(check(
+        "from datetime import date\nd = date.today()\n")) == ["DET101"]
+
+
+def test_det102_deterministic_module_members_exempt():
+    assert not check("import secrets\nok = secrets.compare_digest(a, b)\n")
+    assert not check("import random\ns = random.getstate()\n")
+    assert rules_of(check(
+        "import secrets\nk = secrets.token_hex(8)\n")) == ["DET102"]
+
+
+def test_det103_fs_order():
+    assert rules_of(check(
+        "import os\nfor f in os.listdir(d):\n    h(f)\n")) == ["DET103"]
+    assert check("p = root.iterdir()\n")
+    assert check("import glob\nxs = glob.glob('*.png')\n")
+    assert not check("import os\nfor f in sorted(os.listdir(d)):\n    h(f)\n")
+    assert not check(
+        "names = sorted(p.name for p in root.iterdir())\n")
+
+
+def test_det104_unsorted_dumps():
+    assert rules_of(check("import json\nb = json.dumps(obj)\n")) == \
+        ["DET104"]
+    assert not check("import json\nb = json.dumps(obj, sort_keys=True)\n")
+    # literal dicts with constant keys serialize in source order
+    assert not check("import json\nb = json.dumps({'a': 1, 'b': x})\n")
+
+
+def test_det104_explicit_false_is_flagged():
+    assert rules_of(check(
+        "import json\nb = json.dumps(obj, sort_keys=False)\n")) == \
+        ["DET104"]
+    # a non-constant value is the caller's responsibility
+    assert not check("import json\nb = json.dumps(obj, sort_keys=flag)\n")
+
+
+def test_det105_set_iteration():
+    assert rules_of(check(
+        "for x in {'a', 'b'}:\n    f(x)\n")) == ["DET105"]
+    assert check("ys = [f(x) for x in set(xs)]\n")
+    assert not check("for x in sorted({'a', 'b'}):\n    f(x)\n")
+    assert not check("for x in xs:\n    f(x)\n")
+
+
+def test_det106_runtime_env_mutation():
+    assert rules_of(check(
+        "def f():\n    jax.config.update('jax_enable_x64', True)\n")) == \
+        ["DET106"]
+    assert check("def f():\n    os.environ['JAX_PLATFORMS'] = 'cpu'\n")
+    # module-level configuration is boot-time and fine
+    assert not check("jax.config.update('jax_enable_x64', True)\n")
+
+
+# -- jit purity rules -------------------------------------------------------
+
+def test_jit201_host_escape_decorated():
+    src = ("import jax\nimport numpy as np\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    print('hi')\n"
+           "    y = np.asarray(x)\n"
+           "    z = x.item()\n"
+           "    return float(x)\n")
+    assert rules_of(check(src)) == ["JIT201"] * 4
+
+
+def test_jit201_wrapped_and_lambda_forms():
+    # the jax.jit(with_cast(_init, dtype)) idiom used by every pipeline
+    src = ("import jax\n"
+           "def _init(k):\n"
+           "    return x.item()\n"
+           "jitted = jax.jit(with_cast(_init, dtype))\n")
+    assert rules_of(check(src)) == ["JIT201"]
+    assert rules_of(check(
+        "import jax\ng = jax.jit(lambda x: x.tolist())\n")) == ["JIT201"]
+
+
+def test_jit201_negative_outside_jit():
+    assert not check(
+        "import numpy as np\ndef f(x):\n    return np.asarray(x)\n")
+    # float() on a literal is not a tracer cast
+    assert not check("import jax\n@jax.jit\ndef f(x):\n"
+                     "    return x * float(0.5)\n")
+
+
+def test_jit_collection_ignores_static_args():
+    # only the FIRST jit(...) argument is the compiled function; a
+    # config factory passed alongside must not be poisoned
+    src = ("import jax\n"
+           "def cfg():\n    print('building config')\n"
+           "def build(c, n):\n    return c\n"
+           "step = jax.jit(build(identity, 3), static_argnums=cfg)\n")
+    assert not check(src)
+
+
+def test_jit202_global_mutation():
+    src = ("import jax\n@jax.jit\ndef f(x):\n"
+           "    global _cache\n    _cache = x\n    return x\n")
+    assert rules_of(check(src)) == ["JIT202"]
+    assert not check("def f(x):\n    global _cache\n    _cache = x\n")
+
+
+# -- concurrency rules ------------------------------------------------------
+
+_THREADED = """\
+import threading
+
+class Worker:
+    def __init__(self):
+        self.state = "idle"
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def set_state(self, s):
+        self.state = s
+
+    def _run(self):
+        while self.state != "stop":
+            pass
+"""
+
+
+def test_conc301_unlocked_shared_attribute():
+    hits = check(_THREADED)
+    assert rules_of(hits) == ["CONC301"]
+    assert "self.state" in hits[0].message
+
+
+def test_conc301_lock_on_both_sides_is_clean():
+    src = _THREADED.replace(
+        "        self.state = s",
+        "        with self._lock:\n            self.state = s",
+    ).replace(
+        "        while self.state != \"stop\":\n            pass",
+        "        with self._lock:\n            s = self.state",
+    ).replace(
+        "        self.state = \"idle\"",
+        "        self.state = \"idle\"\n"
+        "        self._lock = threading.Lock()",
+    )
+    assert not check(src)
+
+
+def test_conc301_init_writes_and_primitives_exempt():
+    src = ("import threading\n"
+           "class W:\n"
+           "    def __init__(self):\n"
+           "        self.stop = threading.Event()\n"
+           "        self.name = 'w'\n"
+           "        self._t = threading.Thread(target=self._run)\n"
+           "    def _run(self):\n"
+           "        while not self.stop.wait(1):\n"
+           "            f(self.name)\n")
+    assert not check(src)
+
+
+def test_conc301_init_reads_exempt_too():
+    # a read in __init__ happens-before Thread.start(); it cannot race
+    src = ("import threading\n"
+           "class W:\n"
+           "    def __init__(self):\n"
+           "        self.state = 'idle'\n"
+           "        print(self.state)\n"
+           "        self._t = threading.Thread(target=self._run)\n"
+           "    def _run(self):\n"
+           "        self.state = 'busy'\n")
+    assert not check(src)
+
+
+def test_conc301_only_threaded_classes_analyzed():
+    assert not check(
+        "class Plain:\n"
+        "    def a(self):\n        self.x = 1\n"
+        "    def b(self):\n        return self.x\n")
+
+
+# -- suppressions, enforce, LINT001 -----------------------------------------
+
+def test_inline_suppression_same_line_and_above():
+    assert not check(
+        "import time\n"
+        "t = time.time()  # detlint: allow[DET101] test clock\n")
+    assert not check(
+        "import time\n"
+        "# detlint: allow[DET101] reason spanning\n"
+        "# a second comment line\n"
+        "t = time.time()\n")
+
+
+def test_trailing_pragma_covers_wrapped_statement():
+    # the finding anchors to the expression's FIRST line; a pragma at
+    # the end of the wrapped statement must still reach it
+    assert not check(
+        "import time\n"
+        "t = (time.\n"
+        "     time())  # detlint: allow[DET101] test clock\n")
+
+
+def test_pragma_covers_continuation_line_anchors():
+    # a finding can anchor on a continuation line of a wrapped
+    # statement; both pragma placements must still reach it
+    assert not check(
+        "import time\n"
+        "# detlint: allow[DET101] wrapped call, nested anchor\n"
+        "x = foo(\n"
+        "    time.time())\n")
+    assert not check(
+        "import time\n"
+        "x = foo(\n"
+        "    time.time())  # detlint: allow[DET101] trailing on cont.\n")
+    # and an own-line pragma inside a bracketed literal covers it too
+    assert not check(
+        "import time\n"
+        "x = {\n"
+        "    # detlint: allow[DET101] in-bracket pragma\n"
+        "    'at': time.time(),\n"
+        "}\n")
+
+
+def test_unknown_rule_id_in_directive_is_lint002():
+    hits = check("import time\n"
+                 "t = time.time()  # detlint: allow[DET11] typo'd id\n")
+    assert sorted(rules_of(hits)) == ["DET101", "LINT002"]
+    # an enforce typo is flagged too — it must never silently void the
+    # un-waivable guarantee
+    hits = check("# detlint: enforce[DET1O1]\nx = 1\n")
+    assert rules_of(hits) == ["LINT002"]
+
+
+def test_suppression_without_reason_is_ignored_and_flagged():
+    hits = check("import time\n"
+                 "t = time.time()  # detlint: allow[DET101]\n")
+    assert sorted(rules_of(hits)) == ["DET101", "LINT001"]
+
+
+def test_suppression_is_rule_specific():
+    hits = check("import time\n"
+                 "t = time.time()  # detlint: allow[DET102] wrong rule\n")
+    assert rules_of(hits) == ["DET101"]
+
+
+def test_enforce_defeats_pragma_and_baseline():
+    src = ("# detlint: enforce[DET101]\n"
+           "import time\n"
+           "t = time.time()  # detlint: allow[DET101] nice try\n")
+    hits = analyze_source(src, "solverish.py")
+    assert rules_of(hits) == ["DET101"] and hits[0].enforced
+    bl = baseline_mod.update(hits, None)
+    assert not bl.entries  # enforced findings are never baselined
+    assert Baseline({}).apply(hits) == hits
+
+
+def test_baseline_absorbs_by_snippet_not_line():
+    src = "import time\nt = time.time()\n"
+    hits = analyze_source(src, "f.py")
+    bl = baseline_mod.update(hits, None)
+    # shift the finding down two lines: same snippet, still absorbed
+    moved = analyze_source("import time\n\n\nt = time.time()\n", "f.py")
+    assert not bl.apply(moved)
+    # a SECOND identical occurrence exceeds the count and fails
+    twice = analyze_source(
+        "import time\nt = time.time()\nt = time.time()\n", "f.py")
+    assert len(bl.apply(twice)) == 1
+
+
+# -- golden JSON + output determinism ---------------------------------------
+
+def _json_report(paths, root):
+    findings = analyze_paths(paths, root=root)
+    return json.dumps(
+        {"version": 1, "findings": [f.to_json() for f in findings]},
+        indent=2, sort_keys=True) + "\n"
+
+
+def test_multi_finding_golden_json():
+    got = _json_report([str(FIXDIR / "multi_finding.py")], str(FIXDIR))
+    want = (FIXDIR / "multi_finding.golden.json").read_text()
+    assert got == want
+    doc = json.loads(got)
+    fams = {f["rule"][:3] for f in doc["findings"]}
+    assert {"DET", "JIT"} <= fams and len(doc["findings"]) >= 7
+
+
+def test_two_runs_byte_identical():
+    a = _json_report([str(REPO / "arbius_tpu")], str(REPO))
+    b = _json_report([str(REPO / "arbius_tpu")], str(REPO))
+    assert a == b
+
+
+# -- the tier-1 self-check (the actual guardrail) ---------------------------
+
+def test_package_self_check_clean_against_baseline():
+    findings = analyze_paths([str(REPO / "arbius_tpu")], root=str(REPO))
+    bl = Baseline.load(str(REPO / "detlint-baseline.json"))
+    residue = bl.apply(findings)
+    assert residue == [], (
+        "detlint found non-baselined findings — fix them, pragma them "
+        "with a reason, or (if intentional) run tools/detlint.py "
+        "--baseline-update and justify the new entries:\n"
+        + "\n".join(f.text() for f in residue))
+
+
+def test_baseline_entries_are_justified():
+    doc = json.loads((REPO / "detlint-baseline.json").read_text())
+    for e in doc["findings"]:
+        assert e["reason"] and baseline_mod.UNREVIEWED not in e["reason"], \
+            f"unjustified baseline entry: {e['path']} {e['rule']}"
+
+
+def test_solve_path_files_declare_enforcement():
+    # node/retry.py + node/solver.py must keep their enforce[] pragmas —
+    # deleting the directive would let a future baseline absorb findings
+    from arbius_tpu.analysis import parse_directives
+    for rel, must in [
+        ("arbius_tpu/node/solver.py",
+         {"DET101", "DET102", "DET103", "DET104", "DET105"}),
+        ("arbius_tpu/node/retry.py", {"DET101", "DET102", "DET105"}),
+    ]:
+        d = parse_directives((REPO / rel).read_text())
+        assert d.enforced == must, f"{rel} enforce[] list drifted"
+
+
+def test_injected_wall_clock_in_solver_is_caught(tmp_path):
+    """Rule-rot regression (ISSUE satellite): a synthetic time.time()
+    dropped into the real solver module must produce an ENFORCED DET101
+    that neither pragma nor baseline can absorb."""
+    src = (REPO / "arbius_tpu/node/solver.py").read_text()
+    assert not analyze_source(src, "solver.py"), "solver should be clean"
+    evil = src + ("\n\ndef _drift():\n"
+                  "    import time\n"
+                  "    return time.time()  # detlint: allow[DET101] no\n")
+    hits = analyze_source(evil, "solver.py")
+    assert any(f.rule == "DET101" and f.enforced for f in hits)
+    assert not baseline_mod.update(hits, None).entries
+
+
+def test_injected_rng_in_retry_is_caught():
+    src = (REPO / "arbius_tpu/node/retry.py").read_text()
+    evil = src + ("\n\ndef _jitter(delay):\n"
+                  "    import random\n"
+                  "    return delay * random.random()\n")
+    hits = analyze_source(evil, "retry.py")
+    assert any(f.rule == "DET102" and f.enforced for f in hits)
+
+
+# -- CLI exit codes + baseline update determinism ---------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import time\nt = time.time()\n")
+    bl = str(tmp_path / "bl.json")
+    assert cli_main([str(clean), "--baseline", bl]) == 0
+    assert cli_main([str(dirty), "--baseline", bl]) == 1
+    assert cli_main([str(dirty), "--select", "NOPE"]) == 2
+    assert cli_main([str(tmp_path / "missing.py")]) == 2
+    # an explicitly named non-.py file is a usage error, not "clean"
+    notpy = tmp_path / "script"
+    notpy.write_text("x = 1\n")
+    assert cli_main([str(notpy)]) == 2
+    assert cli_main(["--help"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_baseline_update_deterministic_and_reason_preserving(tmp_path):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import time\nt = time.time()\n")
+    bl = tmp_path / "bl.json"
+    args = [str(dirty), "--root", str(tmp_path), "--baseline", str(bl),
+            "--baseline-update"]
+    assert cli_main(args) == 0
+    doc = json.loads(bl.read_text())
+    assert doc["findings"][0]["reason"] == baseline_mod.UNREVIEWED
+    doc["findings"][0]["reason"] = "test clock, reviewed"
+    bl.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    first = bl.read_bytes()
+    assert cli_main(args) == 0
+    assert bl.read_bytes() == first  # reasons carried, bytes stable
+    assert cli_main([str(dirty), "--root", str(tmp_path),
+                     "--baseline", str(bl)]) == 0
+
+
+def test_cli_baseline_update_refuses_select(tmp_path, capsys):
+    f = tmp_path / "f.py"
+    f.write_text("import time\nt = time.time()\n")
+    rc = cli_main([str(f), "--select", "DET101", "--baseline-update",
+                   "--baseline", str(tmp_path / "bl.json")])
+    assert rc == 2
+    assert not (tmp_path / "bl.json").exists()
+    capsys.readouterr()
+
+
+def test_cli_baseline_update_partial_paths_merge(tmp_path):
+    a = tmp_path / "a.py"
+    a.write_text("import time\nt = time.time()\n")
+    b = tmp_path / "b.py"
+    b.write_text("import random\nr = random.random()\n")
+    bl = tmp_path / "bl.json"
+    assert cli_main([str(a), str(b), "--root", str(tmp_path),
+                     "--baseline", str(bl), "--baseline-update"]) == 0
+    # a partial re-run over just a.py must keep b.py's reviewed entry
+    assert cli_main([str(a), "--root", str(tmp_path),
+                     "--baseline", str(bl), "--baseline-update"]) == 0
+    doc = json.loads(bl.read_text())
+    assert {e["path"] for e in doc["findings"]} == {"a.py", "b.py"}
+    # and a fixed file's entries DO drop out of a partial rescan
+    a.write_text("t = 1\n")
+    assert cli_main([str(a), "--root", str(tmp_path),
+                     "--baseline", str(bl), "--baseline-update"]) == 0
+    doc = json.loads(bl.read_text())
+    assert {e["path"] for e in doc["findings"]} == {"b.py"}
+
+
+def test_cli_unreadable_file_is_usage_error(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_bytes(b"# -*- coding: latin-1 -*-\nx = '\xe9'\n# \xff\xfe\n")
+    # PEP 263 coding declarations are honored (tokenize.open) — this
+    # file is valid latin-1 Python and must analyze, not crash
+    assert cli_main([str(bad), "--root", str(tmp_path),
+                     "--baseline", str(tmp_path / "bl.json")]) == 0
+    truly_bad = tmp_path / "broken.py"
+    truly_bad.write_bytes(b"x = 1\n\xff\xfe\n")  # undeclared, not utf-8
+    rc = cli_main([str(truly_bad), "--root", str(tmp_path),
+                   "--baseline", str(tmp_path / "bl.json")])
+    assert rc == 2  # tool failure is the usage exit, never "findings"
+    capsys.readouterr()
+
+
+def test_cli_json_output_is_sorted(tmp_path, capsys):
+    f = tmp_path / "f.py"
+    f.write_text("import time\nimport random\n"
+                 "t = time.time()\nr = random.random()\n")
+    rc = cli_main([str(f), "--root", str(tmp_path), "--json",
+                   "--baseline", str(tmp_path / "none.json")])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    keys = [(x["path"], x["line"], x["col"], x["rule"])
+            for x in doc["findings"]]
+    assert keys == sorted(keys)
+
+
+# -- tools layer ------------------------------------------------------------
+
+def test_tools_share_arg_output_helper(tmp_path, capsys, monkeypatch):
+    import _common
+    import obs_dump
+
+    import detlint as detlint_tool
+
+    # obs_dump's metrics view is the shared table
+    assert obs_dump.render_metrics({"b": 2, "a": 1.5}) == \
+        _common.kv_table({"b": 2, "a": 1.5}) == "a  1.5\nb  2"
+    # the detlint tool runs the same collect() pipeline with the same
+    # exit-code contract
+    clean = tmp_path / "ok.py"
+    clean.write_text("x = 1\n")
+    assert detlint_tool.main([str(clean),
+                              "--baseline",
+                              str(tmp_path / "bl.json")]) == 0
+    dirty = tmp_path / "bad.py"
+    dirty.write_text("import time\nt = time.time()\n")
+    assert detlint_tool.main([str(dirty),
+                              "--baseline",
+                              str(tmp_path / "bl.json")]) == 1
+    err = capsys.readouterr().err
+    assert "findings by rule" in err and "DET101" in err
+
+
+def test_module_entrypoint_runs():
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    out = subprocess.run(
+        [sys.executable, "-m", "arbius_tpu.analysis",
+         str(REPO / "arbius_tpu"), "--root", str(REPO),
+         "--baseline", str(REPO / "detlint-baseline.json")],
+        capture_output=True, text=True, env=env, cwd=str(REPO))
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -1,0 +1,35 @@
+"""Shared arg/output plumbing for the operator tools in tools/.
+
+Every tool renders terminal tables and builds its parser the same way,
+so the formatting lives once here (obs_dump.py and detlint.py are the
+customers; new tools should start from these):
+
+    make_parser(prog, doc)   argparse.ArgumentParser with the tool's
+                             module docstring as raw description
+    kv_table(mapping)        aligned `key  value` lines, keys sorted,
+                             floats rendered %.6g — the obs metrics view
+                             and the detlint per-rule summary
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def make_parser(prog: str, doc: str | None) -> argparse.ArgumentParser:
+    return argparse.ArgumentParser(
+        prog=prog, description=doc,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+
+
+def kv_table(mapping: dict) -> str:
+    """Aligned key/value table, keys sorted for stable output."""
+    if not mapping:
+        return ""
+    width = max(len(str(k)) for k in mapping)
+    lines = []
+    for k in sorted(mapping):
+        v = mapping[k]
+        if isinstance(v, float):
+            v = f"{v:.6g}"
+        lines.append(f"{str(k).ljust(width)}  {v}")
+    return "\n".join(lines)

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""detlint — determinism & concurrency static analysis over the tree.
+
+Pre-commit / CI front door for `arbius_tpu.analysis` (the rule catalog
+lives in docs/static-analysis.md):
+
+    python tools/detlint.py                      # lint arbius_tpu/
+    python tools/detlint.py --json arbius_tpu    # stable JSON report
+    python tools/detlint.py --baseline-update    # regenerate baseline
+    python tools/detlint.py --select DET101 node # one rule, one dir
+
+Exit codes: 0 clean / 1 findings / 2 usage error — safe to wire
+directly into a pre-commit hook or CI step. A per-rule finding summary
+is printed to stderr after the report (same aligned-table helper the
+obs_dump metrics view uses).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import kv_table, make_parser
+
+from arbius_tpu.analysis.cli import build_arg_parser, collect, render
+
+
+def main(argv=None) -> int:
+    parser = build_arg_parser(make_parser("detlint", __doc__))
+    try:
+        ns = parser.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    rc, findings = collect(ns)
+    if rc is not None:
+        return rc
+    render(ns, findings, sys.stdout)
+    if findings and not ns.json:
+        # quick triage view: which rules are firing, how often
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print("\nfindings by rule:\n" + kv_table(counts), file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,10 +15,11 @@ are pure (tests drive them against an in-process ControlRPC).
 """
 from __future__ import annotations
 
-import argparse
 import json
 import sys
 import urllib.request
+
+from _common import kv_table, make_parser
 
 
 def fetch_json(url: str, timeout: float = 10.0):
@@ -32,14 +33,7 @@ def fetch_text(url: str, timeout: float = 10.0) -> str:
 
 
 def render_metrics(m: dict) -> str:
-    width = max(len(k) for k in m) if m else 0
-    lines = []
-    for k in sorted(m):
-        v = m[k]
-        if isinstance(v, float):
-            v = f"{v:.6g}"
-        lines.append(f"{k.ljust(width)}  {v}")
-    return "\n".join(lines)
+    return kv_table(m)
 
 
 def _event_line(e: dict) -> str:
@@ -78,7 +72,7 @@ def render_trace(roots: list[dict], indent: int = 0) -> str:
 
 
 def main(argv=None) -> int:
-    p = argparse.ArgumentParser(prog="obs_dump", description=__doc__)
+    p = make_parser("obs_dump", __doc__)
     p.add_argument("--url", default="http://127.0.0.1:8080",
                    help="node control-RPC base URL")
     sub = p.add_subparsers(dest="cmd", required=True)
