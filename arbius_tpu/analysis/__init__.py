@@ -8,7 +8,8 @@ stack checks graph properties — statically, over the whole tree, on
 every PR (the tier-1 self-check in tests/test_analysis.py runs it over
 `arbius_tpu/` and fails on any non-baselined finding).
 
-Three rule families (docs/static-analysis.md has the full catalog):
+Three source-level rule families (docs/static-analysis.md has the full
+catalog):
 
     DET1xx  determinism  — wall clock, host RNG, filesystem order,
                            unsorted serialization, set iteration,
@@ -17,6 +18,12 @@ Three rule families (docs/static-analysis.md has the full catalog):
                            jax.jit/pjit-compiled functions
     CONC3xx concurrency  — unlocked attributes shared with
                            threading.Thread targets
+
+The sibling subpackage `arbius_tpu.analysis.graph` ("graphlint",
+docs/graph-audit.md) audits one level down — the traced XLA programs
+themselves (GRAPH4xx rules + golden fingerprints in goldens/graph/) —
+reusing this package's Finding schema, report format, and exit-code
+contract.
 
 Escape hatches: inline `# detlint: allow[RULE] reason` pragmas and the
 checked-in `detlint-baseline.json`; `# detlint: enforce[RULE]` makes a

@@ -26,6 +26,22 @@ from arbius_tpu.analysis.core import (
 
 DEFAULT_BASELINE = "detlint-baseline.json"
 
+# THE lint exit-code contract, shared by every analysis front door:
+# detlint & graphlint package CLIs here, and the tools/ wrappers via
+# tools/_common.py (which re-exports these — single definition).
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_USAGE = 2
+
+
+def render_json(findings, out, version: int = 1) -> None:
+    """The one JSON report emission (stable: findings sorted, keys
+    sorted) — detlint, graphlint, and the tools/ wrappers all emit
+    exactly this document shape."""
+    doc = {"version": version,
+           "findings": [f.to_json() for f in findings]}
+    out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+
 
 def build_arg_parser(p: argparse.ArgumentParser | None = None
                      ) -> argparse.ArgumentParser:
@@ -71,19 +87,19 @@ def collect(ns: argparse.Namespace):
             print("detlint: --baseline-update cannot be combined with "
                   "--select (it would drop entries for unselected rules)",
                   file=sys.stderr)
-            return 2, []
+            return EXIT_USAGE, []
         select = {r.strip() for r in ns.select.split(",") if r.strip()}
         unknown = select - set(RULES) - {"LINT001", "LINT002"}
         if unknown:
             print(f"detlint: unknown rule id(s): "
                   f"{', '.join(sorted(unknown))}", file=sys.stderr)
-            return 2, []
+            return EXIT_USAGE, []
     try:
         findings, analyzed = analyze_tree(list(ns.paths), root=ns.root,
                                           select=select)
     except AnalysisError as e:
         print(f"detlint: {e}", file=sys.stderr)
-        return 2, []
+        return EXIT_USAGE, []
 
     prev = None
     try:
@@ -93,7 +109,7 @@ def collect(ns: argparse.Namespace):
     except (OSError, ValueError, KeyError) as e:
         print(f"detlint: unreadable baseline {ns.baseline}: {e}",
               file=sys.stderr)
-        return 2, []
+        return EXIT_USAGE, []
 
     if ns.baseline_update:
         baseline_mod.update(findings, prev,
@@ -105,7 +121,7 @@ def collect(ns: argparse.Namespace):
         for f in kept:
             print(f.text() + "  [enforced — cannot be baselined]",
                   file=sys.stderr)
-        return (1 if kept else 0), kept
+        return (EXIT_FINDINGS if kept else EXIT_CLEAN), kept
 
     if prev is not None and not ns.no_baseline:
         findings = prev.apply(findings)
@@ -116,9 +132,7 @@ def render(ns: argparse.Namespace, findings, out) -> None:
     """The one definition of the report format — `python -m
     arbius_tpu.analysis` and tools/detlint.py both emit exactly this."""
     if ns.json:
-        doc = {"version": 1,
-               "findings": [f.to_json() for f in findings]}
-        out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+        render_json(findings, out)
     else:
         for f in findings:
             out.write(f.text() + "\n")
@@ -132,17 +146,30 @@ def run(ns: argparse.Namespace, out=None) -> int:
     if rc is not None:
         return rc
     render(ns, findings, out)
-    return 1 if findings else 0
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
 
 
-def main(argv: list[str] | None = None) -> int:
-    parser = build_arg_parser()
+def cli_entry(build_parser, collect_fn, render_fn,
+              argv: list[str] | None = None) -> int:
+    """The one parse→collect→render→exit loop every lint front door
+    runs (detlint and graphlint `main`s here; tools/_common.py wraps
+    this with the tools' stderr summary): argparse exits 2 on usage
+    error and 0 on --help — both preserved — then the collect/render
+    split maps onto the shared exit-code contract."""
+    parser = build_parser()
     try:
         ns = parser.parse_args(argv)
     except SystemExit as e:
-        # argparse exits 2 on usage error, 0 on --help — preserve both
         return int(e.code or 0)
-    return run(ns)
+    rc, findings = collect_fn(ns)
+    if rc is not None:
+        return rc
+    render_fn(ns, findings, sys.stdout)
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
+
+
+def main(argv: list[str] | None = None) -> int:
+    return cli_entry(build_arg_parser, collect, render, argv)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,86 @@
+"""arbius_tpu.analysis.graph — "graphlint", the compiled-program auditor.
+
+detlint (the sibling package) reads Python source; this package reads
+what actually ships to the accelerator. Every registered pipeline
+declares its jittable entry points as `TraceSpec`s
+(`arbius_tpu/models/trace_specs.py`); graphlint traces each to a jaxpr
+at tiny CPU-traceable shapes — abstract params, abstract meshes, no
+devices — and then:
+
+  1. runs the GRAPH4xx rule family over the graph (host callbacks,
+     non-unique scatter accumulation, named-axis reduction order,
+     float64 drift, sub-f32 accumulation, constant PRNG seeds);
+  2. computes a canonical program fingerprint (vars renumbered,
+     metadata stripped, consts digested — fingerprint.py) and checks it
+     against the checked-in `goldens/graph/` directory, failing closed
+     with a structural diff on any drift (GRAPH49x).
+
+docs/determinism.md defines the determinism class by XLA program
+identity; this is the gate that makes that definition enforceable —
+a PR that silently changes a traced graph (a reduction order, a dtype,
+a new callback) fails tier-1 before it can fork honest miners.
+
+CLI: `python -m arbius_tpu.analysis.graph` or `tools/graphlint.py`
+(exit 0 clean / 1 findings / 2 usage, same contract as detlint);
+`--golden-update` regenerates the goldens. `audit()` is the same
+pipeline as a library call for tests and tools.
+"""
+from __future__ import annotations
+
+from arbius_tpu.analysis.core import Finding
+from arbius_tpu.analysis.graph import goldens as _goldens
+from arbius_tpu.analysis.graph.fingerprint import (
+    canonical_eqns,
+    canonical_lines,
+    diff_summaries,
+    fingerprint,
+    summarize,
+)
+from arbius_tpu.analysis.graph.rules import GRAPH_RULES, graph_rule, run_rules
+from arbius_tpu.analysis.graph.trace import (
+    TracedProgram,
+    report_findings_obs,
+    trace_spec,
+    trace_specs,
+)
+
+
+def audit(specs=None, goldens_dir: str | None = None,
+          check_goldens: bool = True,
+          all_keys_expected: bool | None = None) -> list[Finding]:
+    """Trace `specs` (default: the full registry), run every GRAPH4xx
+    rule, and (optionally) the golden gate. Returns sorted findings —
+    empty means the gate is green. Obs counters are reported the same
+    way the CLI reports them.
+
+    `all_keys_expected` controls whether goldens with no traced spec
+    report as stale (GRAPH492); by default it is True only for a
+    full-registry audit — an explicit `specs` subset is a partial run,
+    where unmatched goldens are expected, not stale (same semantics as
+    the CLI's `--spec` filter)."""
+    full_registry = specs is None
+    if full_registry:
+        from arbius_tpu.models import all_trace_specs
+
+        specs = all_trace_specs()
+    if all_keys_expected is None:
+        all_keys_expected = full_registry
+    programs = [trace_spec(s) for s in specs]
+    findings: list[Finding] = []
+    for p in programs:
+        findings.extend(run_rules(p))
+    if check_goldens:
+        findings.extend(_goldens.check(
+            programs, goldens_dir or _goldens.DEFAULT_GOLDENS_DIR,
+            all_keys_expected=all_keys_expected))
+    findings.sort()
+    report_findings_obs(findings)
+    return findings
+
+
+__all__ = [
+    "GRAPH_RULES", "Finding", "TracedProgram", "audit", "canonical_eqns",
+    "canonical_lines", "diff_summaries", "fingerprint", "graph_rule",
+    "report_findings_obs", "run_rules", "summarize", "trace_spec",
+    "trace_specs",
+]

@@ -1,0 +1,152 @@
+"""graphlint command line — `python -m arbius_tpu.analysis.graph` /
+tools/graphlint.py.
+
+Same contract as detlint (the constants are literally shared —
+analysis/cli.py):
+
+    0   clean (every spec traced, no GRAPH4xx finding, goldens match)
+    1   findings (rule hits OR fingerprint mismatch/missing/stale)
+    2   usage error (bad spec filter, unreadable golden, trace failure)
+
+`--golden-update` regenerates `goldens/graph/` deterministically and
+exits 0 — but ONLY for the fingerprint gate: GRAPH4xx rule findings
+are still reported and still exit 1, so a host callback or dtype drift
+cannot be laundered into the tree by regenerating goldens.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from arbius_tpu.analysis.cli import (
+    EXIT_CLEAN,
+    EXIT_FINDINGS,
+    EXIT_USAGE,
+    render_json,
+)
+from arbius_tpu.analysis.core import AnalysisError
+from arbius_tpu.analysis.graph import goldens as goldens_mod
+from arbius_tpu.analysis.graph.rules import GRAPH_RULES, run_rules
+from arbius_tpu.analysis.graph.trace import (
+    report_findings_obs,
+    trace_spec,
+)
+
+
+def build_arg_parser(p: argparse.ArgumentParser | None = None
+                     ) -> argparse.ArgumentParser:
+    """Populate `p` (or a fresh parser) with the graphlint arguments —
+    tools/graphlint.py builds its parser through tools/_common.py and
+    passes it here, so tool and module stay argument-identical."""
+    if p is None:
+        p = argparse.ArgumentParser(
+            prog="graphlint", description=__doc__,
+            formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (same stable document "
+                        "shape as detlint --json)")
+    p.add_argument("--goldens", default=goldens_mod.DEFAULT_GOLDENS_DIR,
+                   help="golden fingerprint directory (default: "
+                        f"{goldens_mod.DEFAULT_GOLDENS_DIR})")
+    p.add_argument("--golden-update", action="store_true",
+                   help="rewrite goldens from the current traces (prunes "
+                        "stale files unless --spec filters the run) and "
+                        "exit 0 — rule findings still exit 1")
+    p.add_argument("--spec", default=None,
+                   help="substring filter over spec keys (partial runs "
+                        "check/update only matching specs)")
+    p.add_argument("--select", default=None,
+                   help="comma-separated GRAPH rule ids to run "
+                        "(default: all; the golden gate always runs)")
+    p.add_argument("--list", action="store_true",
+                   help="list registered spec keys and exit 0")
+    return p
+
+
+def _specs(ns: argparse.Namespace):
+    from arbius_tpu.models import all_trace_specs
+
+    specs = all_trace_specs()
+    if ns.spec:
+        specs = [s for s in specs if ns.spec in s.key]
+        if not specs:
+            raise AnalysisError(f"--spec {ns.spec!r} matches no "
+                                "registered trace spec")
+    return specs
+
+
+def collect(ns: argparse.Namespace):
+    """Trace + audit per the parsed args. Returns (exit_code, findings);
+    a non-None exit code short-circuits (usage error, --list, or
+    --golden-update done) — tools/graphlint.py shares this so tool and
+    module agree exactly."""
+    select = None
+    if ns.select:
+        select = {r.strip() for r in ns.select.split(",") if r.strip()}
+        unknown = select - set(GRAPH_RULES)
+        if unknown:
+            print(f"graphlint: unknown rule id(s): "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return EXIT_USAGE, []
+    try:
+        specs = _specs(ns)
+        if ns.list:
+            for s in specs:
+                print(s.key)
+            return EXIT_CLEAN, []
+        programs = [trace_spec(s) for s in specs]
+        findings = []
+        for p in programs:
+            findings.extend(run_rules(p, select=select))
+        if ns.golden_update:
+            written, pruned = goldens_mod.update(
+                programs, ns.goldens, prune=not ns.spec)
+            print(f"graphlint: {len(written)} golden(s) written to "
+                  f"{ns.goldens}" +
+                  (f", {len(pruned)} stale pruned" if pruned else "") +
+                  (" — rule findings below are NOT absorbed"
+                   if findings else ""),
+                  file=sys.stderr)
+            # fall through to the normal render/exit path: the goldens
+            # are updated, but GRAPH4xx findings still report (on
+            # stdout, honoring --json) and still exit 1
+        else:
+            findings.extend(goldens_mod.check(
+                programs, ns.goldens, all_keys_expected=not ns.spec))
+    except AnalysisError as e:
+        print(f"graphlint: {e}", file=sys.stderr)
+        return EXIT_USAGE, []
+    findings.sort()
+    report_findings_obs(findings)
+    return None, findings
+
+
+def render(ns: argparse.Namespace, findings, out) -> None:
+    """Same report surface as detlint: text lines or the shared stable
+    JSON document."""
+    if ns.json:
+        render_json(findings, out)
+    else:
+        for f in findings:
+            out.write(f.text() + "\n")
+        if findings:
+            out.write(f"graphlint: {len(findings)} finding(s)\n")
+
+
+def run(ns: argparse.Namespace, out=None) -> int:
+    out = out or sys.stdout
+    rc, findings = collect(ns)
+    if rc is not None:
+        return rc
+    render(ns, findings, out)
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
+
+
+def main(argv: list[str] | None = None) -> int:
+    from arbius_tpu.analysis.cli import cli_entry
+
+    return cli_entry(build_arg_parser, collect, render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,256 @@
+"""Canonical program fingerprints over jaxprs.
+
+The determinism class (docs/determinism.md) is defined by XLA *program
+identity* — so the thing the golden gate must hash is the traced graph,
+not whatever `str(jaxpr)` happens to print. This module re-emits a
+ClosedJaxpr as canonical text with:
+
+  - variables renumbered `v0, v1, ...` in binding order (jax's
+    pretty-printer names and its helper-dedup labels are presentation,
+    not identity); sub-jaxpr parameter lists are emitted explicitly so
+    argument order stays part of the identity;
+  - trace metadata stripped (`name=` params, anything whose repr would
+    embed an object address);
+  - constants digested by (dtype, shape, bytes) — sampler tables and
+    norm epsilons are baked into the graph as consts, and a schedule
+    change must move the fingerprint even when the op mix is identical;
+  - meshes reduced to their (axis, size) shape — device ids never enter
+    (trace specs use `parallel.abstract_mesh`, which has none).
+
+`fingerprint()` is sha256 over those lines. `summarize()` distills the
+same walk into a small structural histogram that goldens store next to
+the hash, so a mismatch can be explained (`diff_summaries`) instead of
+just detected — two hex strings differing is not reviewable, "+12
+reduce_sum over bf16" is.
+
+Stability contract: byte-identical across processes and hosts for the
+same jax/flax build (tier-1 proves the re-run; the canonicalization
+tests prove naming/metadata independence). A jax upgrade that changes
+lowering IS a determinism-class change and legitimately regenerates
+`goldens/graph/` (see its README).
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Any, Iterator
+
+import numpy as np
+from jax.extend import core as jex_core
+
+_ADDR = re.compile(r" at 0x[0-9a-f]+", re.IGNORECASE)
+
+# presentation-only eqn params: stripped before hashing. `name` is the
+# python function name a pjit/custom call was traced from — renaming a
+# helper must not move the fleet's determinism class.
+METADATA_PARAMS = frozenset({"name", "inline", "keep_unused"})
+
+# reductions whose float result depends on accumulation ORDER (sum/prod
+# chains); min/max are exact in any order and deliberately absent
+ACCUMULATING_REDUCTIONS = frozenset({
+    "reduce_sum", "reduce_prod", "cumsum", "cumprod", "cumlogsumexp",
+    "psum", "dot_general", "reduce",
+})
+
+
+class _Namer:
+    """Variables renumbered in first-sight order; literals inlined."""
+
+    def __init__(self) -> None:
+        self._names: dict[Any, str] = {}
+
+    def name(self, atom) -> str:
+        if isinstance(atom, jex_core.Literal):
+            return f"lit({_const_str(atom.val)})"
+        got = self._names.get(atom)
+        if got is None:
+            got = self._names[atom] = f"v{len(self._names)}"
+        return got
+
+
+def _const_str(val) -> str:
+    """Value identity for literals/consts: dtype, shape, then exact
+    bytes (digested when large). tolist() reprs are byte-stable for
+    scalars; arrays go through the buffer so float bit patterns count."""
+    arr = np.asarray(val)
+    if arr.size <= 1:
+        return f"{arr.dtype}:{arr.shape}:{arr.tolist()!r}"
+    digest = hashlib.sha256(np.ascontiguousarray(arr).tobytes())
+    return f"{arr.dtype}:{arr.shape}:sha256:{digest.hexdigest()[:32]}"
+
+
+def _aval_str(aval) -> str:
+    try:
+        return aval.str_short(short_dtypes=True)
+    except (AttributeError, TypeError):
+        return _ADDR.sub("", repr(aval))
+
+
+def _param_str(value) -> str:
+    """Canonical repr for one eqn param value (sub-jaxprs are handled
+    by the traversal — this only sees plain data)."""
+    if isinstance(value, (list, tuple)):
+        inner = ",".join(_param_str(v) for v in value)
+        return f"({inner})"
+    if isinstance(value, dict):
+        inner = ",".join(
+            f"{k}={_param_str(v)}"
+            for k, v in sorted(value.items(), key=lambda kv: repr(kv[0])))
+        return f"{{{inner}}}"
+    if isinstance(value, np.ndarray):
+        return _const_str(value)
+    shape = getattr(value, "shape", None)
+    if shape is not None and hasattr(shape, "items"):
+        # Mesh / AbstractMesh: identity is the (axis, size) shape only
+        axes = ",".join(f"{a}:{n}" for a, n in shape.items())
+        return f"mesh({axes})"
+    if callable(value) and not isinstance(value, type):
+        # traced-from callables (callbacks, custom primitives): the
+        # qualname is the stable part; the object address is not
+        return f"fn:{getattr(value, '__qualname__', type(value).__name__)}"
+    return _ADDR.sub("", repr(value))
+
+
+def _is_jaxpr(x) -> bool:
+    return isinstance(x, (jex_core.Jaxpr, jex_core.ClosedJaxpr))
+
+
+def _jaxpr_of(x):
+    return x.jaxpr if isinstance(x, jex_core.ClosedJaxpr) else x
+
+
+def _sub_jaxprs(eqn) -> Iterator[tuple[str, int, Any]]:
+    """(param_key, index, sub_jaxpr) for every jaxpr-valued eqn param,
+    in sorted-key order — the ONE traversal order indices and canonical
+    text both derive from."""
+    for key in sorted(eqn.params):
+        value = eqn.params[key]
+        subs = value if isinstance(value, (list, tuple)) else (value,)
+        for i, sub in enumerate(subs):
+            if _is_jaxpr(sub):
+                yield key, i, sub
+
+
+def canonical_eqns(closed) -> Iterator[tuple[int, Any]]:
+    """Depth-first (eqn_index, eqn) over a jaxpr and every sub-jaxpr in
+    its eqn params (pjit/scan/cond/shard_map bodies). The index is the
+    stable anchor findings and snippets use, and matches the `N:` line
+    numbers in `canonical_lines`."""
+    counter = [0]
+
+    def walk(jx) -> Iterator[tuple[int, Any]]:
+        for eqn in jx.eqns:
+            idx = counter[0]
+            counter[0] += 1
+            yield idx, eqn
+            for _, _, sub in _sub_jaxprs(eqn):
+                yield from walk(_jaxpr_of(sub))
+
+    yield from walk(_jaxpr_of(closed))
+
+
+def eqn_line(eqn, namer: _Namer | None = None) -> str:
+    """One canonical text line for an equation (sub-jaxprs contribute
+    their own lines via the traversal)."""
+    namer = namer or _Namer()
+    outs = " ".join(f"{namer.name(v)}:{_aval_str(v.aval)}"
+                    for v in eqn.outvars)
+    ins = " ".join(namer.name(v) for v in eqn.invars)
+    parts = []
+    for key in sorted(eqn.params):
+        if key in METADATA_PARAMS:
+            continue
+        value = eqn.params[key]
+        subs = value if isinstance(value, (list, tuple)) else (value,)
+        if any(_is_jaxpr(s) for s in subs):
+            parts.append(f"{key}=<jaxpr x{len(tuple(subs))}>")
+            continue
+        parts.append(f"{key}={_param_str(value)}")
+    params = (" [" + " ".join(parts) + "]") if parts else ""
+    return f"{outs} = {eqn.primitive.name}{params} {ins}"
+
+
+def _emit(jx, namer: _Namer, counter: list) -> Iterator[str]:
+    for eqn in jx.eqns:
+        idx = counter[0]
+        counter[0] += 1
+        yield f"{idx}: {eqn_line(eqn, namer)}"
+        for key, i, sub in _sub_jaxprs(eqn):
+            inner = _jaxpr_of(sub)
+            # the binder line fixes the sub-jaxpr's argument ORDER in
+            # the text — without it, alpha-renaming could merge bodies
+            # that consume their operands in different orders
+            binder = " ".join(f"{namer.name(v)}:{_aval_str(v.aval)}"
+                              for v in inner.invars)
+            yield f"sub {key}[{i}] lambda {binder}"
+            if isinstance(sub, jex_core.ClosedJaxpr):
+                for cvar, cval in zip(inner.constvars, sub.consts):
+                    yield f"const {namer.name(cvar)} = {_const_str(cval)}"
+            yield from _emit(inner, namer, counter)
+            yield "ret " + " ".join(namer.name(v) for v in inner.outvars)
+
+
+def canonical_lines(closed) -> Iterator[str]:
+    """The canonical text of a ClosedJaxpr: one line per eqn (numbered
+    to match `canonical_eqns`), plus explicit binder/const/return lines
+    so variable identity is purely positional."""
+    namer = _Namer()
+    jaxpr = closed.jaxpr
+    yield "in " + " ".join(f"{namer.name(v)}:{_aval_str(v.aval)}"
+                           for v in jaxpr.invars)
+    for var, val in zip(jaxpr.constvars, closed.consts):
+        yield f"const {namer.name(var)} = {_const_str(val)}"
+    yield from _emit(jaxpr, namer, [0])
+    yield "out " + " ".join(namer.name(v) for v in jaxpr.outvars)
+
+
+def fingerprint(closed) -> str:
+    """sha256 over the canonical text — the program's identity string
+    (prefixed so the hash construction can be versioned)."""
+    h = hashlib.sha256()
+    for line in canonical_lines(closed):
+        h.update(line.encode("utf-8"))
+        h.update(b"\n")
+    return f"sha256:{h.hexdigest()}"
+
+
+def summarize(closed) -> dict:
+    """Structural histogram stored beside the hash in a golden: enough
+    shape to explain a mismatch, small enough to review in a PR diff."""
+    prims: dict[str, int] = {}
+    dtypes: dict[str, int] = {}
+    accums: dict[str, int] = {}
+    total = 0
+    for _, eqn in canonical_eqns(closed):
+        total += 1
+        name = eqn.primitive.name
+        prims[name] = prims.get(name, 0) + 1
+        for out in eqn.outvars:
+            dt = getattr(getattr(out, "aval", None), "dtype", None)
+            if dt is not None:
+                dtypes[str(dt)] = dtypes.get(str(dt), 0) + 1
+        if name in ACCUMULATING_REDUCTIONS and eqn.invars:
+            dt = getattr(getattr(eqn.invars[0], "aval", None), "dtype", None)
+            if dt is not None:
+                key = f"{name}[{dt}]"
+                accums[key] = accums.get(key, 0) + 1
+    return {"eqns": total, "primitives": prims, "out_dtypes": dtypes,
+            "accumulations": accums}
+
+
+def diff_summaries(old: dict, new: dict) -> list[str]:
+    """Readable structural delta between two summaries — the body of a
+    fingerprint-mismatch finding."""
+    lines: list[str] = []
+    if old.get("eqns") != new.get("eqns"):
+        lines.append(f"eqns: {old.get('eqns')} -> {new.get('eqns')}")
+    for field in ("primitives", "out_dtypes", "accumulations"):
+        a, b = old.get(field, {}), new.get(field, {})
+        for key in sorted(set(a) | set(b)):
+            if a.get(key, 0) != b.get(key, 0):
+                lines.append(
+                    f"{field}.{key}: {a.get(key, 0)} -> {b.get(key, 0)}")
+    if not lines:
+        lines.append("structure unchanged — constants or metadata-adjacent "
+                     "content moved (e.g. a sampler table or norm epsilon)")
+    return lines

@@ -1,0 +1,149 @@
+"""The golden gate — checked-in fingerprints under `goldens/graph/`.
+
+One JSON document per trace-spec key: the canonical program fingerprint
+plus the structural summary that explains it. `check` compares a traced
+registry against the directory and fails CLOSED:
+
+    GRAPH490  fingerprint mismatch (the program changed) — the finding
+              message carries the structural diff
+    GRAPH491  spec has no recorded golden (new program, nothing vouches
+              for it yet)
+    GRAPH492  golden has no spec (stale file — a silently dropped
+              program is as suspicious as a changed one)
+
+None of these are waivable: a changed XLA program is a determinism-
+class fork (docs/determinism.md) until a human regenerates the goldens
+with `--golden-update` and justifies the diff in review —
+`goldens/graph/README.md` says when that is legitimate.
+
+Documents are written deterministically (sorted keys, `\n`, trailing
+newline) so regeneration with no underlying change is a zero diff.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from arbius_tpu.analysis.core import AnalysisError, Finding
+from arbius_tpu.analysis.graph.fingerprint import (
+    diff_summaries,
+    fingerprint,
+    summarize,
+)
+from arbius_tpu.analysis.graph.trace import TracedProgram
+
+DEFAULT_GOLDENS_DIR = os.path.join("goldens", "graph")
+VERSION = 1
+
+
+def golden_path(goldens_dir: str, key: str) -> str:
+    return os.path.join(goldens_dir, f"{key}.json")
+
+
+def golden_doc(program: TracedProgram) -> dict:
+    return {
+        "version": VERSION,
+        "key": program.spec.key,
+        "fingerprint": fingerprint(program.closed),
+        "summary": summarize(program.closed),
+    }
+
+
+def write_golden(goldens_dir: str, doc: dict) -> str:
+    path = golden_path(goldens_dir, doc["key"])
+    os.makedirs(goldens_dir, exist_ok=True)
+    with open(path, "w", encoding="utf-8", newline="\n") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_golden(goldens_dir: str, key: str) -> dict | None:
+    path = golden_path(goldens_dir, key)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        raise AnalysisError(f"unreadable golden {path}: {e}") from e
+    if doc.get("version") != VERSION or doc.get("key") != key or \
+            not isinstance(doc.get("fingerprint"), str):
+        raise AnalysisError(
+            f"malformed golden {path}: version/key/fingerprint fields "
+            "do not match the file's name and schema")
+    return doc
+
+
+def recorded_keys(goldens_dir: str) -> list[str]:
+    """Keys with a recorded golden, sorted (filesystem order never
+    reaches a report)."""
+    try:
+        names = sorted(os.listdir(goldens_dir))
+    except FileNotFoundError:
+        return []
+    return [n[:-5] for n in names if n.endswith(".json")]
+
+
+def check(programs: list[TracedProgram], goldens_dir: str,
+          all_keys_expected: bool = True) -> list[Finding]:
+    """Golden-gate findings for a traced registry. `all_keys_expected`
+    is False for a `--spec`-filtered run, where unmatched golden files
+    are expected rather than stale."""
+    findings: list[Finding] = []
+    traced = {p.spec.key: p for p in programs}
+    for key in sorted(traced):
+        p = traced[key]
+        doc = load_golden(goldens_dir, key)
+        if doc is None:
+            findings.append(Finding(
+                path=key, line=0, col=0, rule="GRAPH491",
+                severity="error",
+                message=("no golden fingerprint recorded — run "
+                         "`tools/graphlint.py --golden-update` and review "
+                         "the new program (goldens/graph/README.md)"),
+                snippet="", enforced=True))
+            continue
+        got = fingerprint(p.closed)
+        if got != doc["fingerprint"]:
+            diff = "; ".join(
+                diff_summaries(doc.get("summary", {}), summarize(p.closed)))
+            findings.append(Finding(
+                path=key, line=0, col=0, rule="GRAPH490",
+                severity="error",
+                message=("XLA program fingerprint drifted from golden "
+                         f"({doc['fingerprint'][:23]}... -> {got[:23]}...): "
+                         f"{diff} — an intended change must be regenerated "
+                         "with --golden-update and justified in review"),
+                snippet="", enforced=True))
+    if all_keys_expected:
+        for key in recorded_keys(goldens_dir):
+            if key not in traced:
+                findings.append(Finding(
+                    path=key, line=0, col=0, rule="GRAPH492",
+                    severity="error",
+                    message=("golden has no matching trace spec — the "
+                             "program was dropped or its key renamed; "
+                             "delete the stale golden via --golden-update "
+                             "if intentional"),
+                    snippet="", enforced=True))
+    findings.sort()
+    return findings
+
+
+def update(programs: list[TracedProgram], goldens_dir: str,
+           prune: bool = True) -> tuple[list[str], list[str]]:
+    """Regenerate goldens from traced programs; returns (written,
+    pruned) paths. `prune=False` for `--spec`-filtered partial updates
+    (mirrors detlint's partial `--baseline-update` semantics: a slice
+    refresh must not delete every other program's entry)."""
+    written = [write_golden(goldens_dir, golden_doc(p)) for p in programs]
+    pruned: list[str] = []
+    if prune:
+        traced = {p.spec.key for p in programs}
+        for key in recorded_keys(goldens_dir):
+            if key not in traced:
+                path = golden_path(goldens_dir, key)
+                os.remove(path)
+                pruned.append(path)
+    return sorted(written), pruned

@@ -1,0 +1,288 @@
+"""graphlint rules (GRAPH4xx) — determinism audits over traced jaxprs.
+
+detlint's DET/JIT families read Python source; these read the COMPILED
+program, where the properties that actually define the determinism
+class live (docs/determinism.md): which primitives run, in what dtype,
+reducing over which mesh axes, seeded from what. A rule is a function
+`(TracedProgram) -> Iterable[(eqn_index, message)]` registered with
+`@graph_rule(...)`; the driver wraps hits into the same `Finding`
+schema detlint reports, with `path` = the trace-spec key and `line` =
+the canonical equation index (matching the `N:` lines
+`fingerprint.canonical_lines` emits, so a finding can be located in
+the canonical text).
+
+Waivers: a spec may carry `allow=(("GRAPH402", "reason"), ...)` —
+spec-level, reason-mandatory, mirroring detlint's inline pragmas
+(source pragmas can't annotate a traced graph, so the waiver rides the
+spec). GRAPH49x golden-gate findings are not rule findings and can
+never be waived (goldens.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Iterable
+
+from arbius_tpu.analysis.core import Finding
+from arbius_tpu.analysis.graph.fingerprint import (
+    _jaxpr_of,
+    _sub_jaxprs,
+    canonical_eqns,
+    eqn_line,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from arbius_tpu.analysis.graph.trace import TracedProgram
+
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclass
+class GraphRule:
+    id: str
+    severity: str
+    summary: str
+    check: Callable[["TracedProgram"], Iterable[tuple[int, str]]]
+
+
+GRAPH_RULES: dict[str, GraphRule] = {}
+
+
+def graph_rule(rule_id: str, severity: str, summary: str):
+    if severity not in SEVERITIES:
+        raise ValueError(f"bad severity {severity!r} for {rule_id}")
+
+    def deco(fn):
+        if rule_id in GRAPH_RULES:
+            raise ValueError(f"duplicate graph rule id {rule_id}")
+        GRAPH_RULES[rule_id] = GraphRule(rule_id, severity, summary, fn)
+        return fn
+
+    return deco
+
+
+def _snippet(eqn, limit: int = 160) -> str:
+    line = eqn_line(eqn)
+    return line if len(line) <= limit else line[:limit - 3] + "..."
+
+
+def run_rules(program: "TracedProgram",
+              select: set[str] | None = None) -> list[Finding]:
+    """All (selected) GRAPH4xx rules over one traced program, waivers
+    applied, sorted on the shared Finding order."""
+    eqns = dict(canonical_eqns(program.closed))
+    findings: list[Finding] = []
+    for rid in sorted(GRAPH_RULES):
+        if select is not None and rid not in select:
+            continue
+        r = GRAPH_RULES[rid]
+        if program.spec.waiver(rid) is not None:
+            continue
+        for idx, message in r.check(program):
+            eqn = eqns.get(idx)
+            findings.append(Finding(
+                path=program.spec.key, line=idx, col=0, rule=rid,
+                severity=r.severity, message=message,
+                snippet=_snippet(eqn) if eqn is not None else ""))
+    findings.sort()
+    return findings
+
+
+# -- the rules ---------------------------------------------------------------
+
+_CALLBACK_PRIMS = ("pure_callback", "io_callback", "debug_callback",
+                   "outside_call", "host_callback_call")
+
+
+@graph_rule("GRAPH401", "error",
+            "host callback embedded in a compiled program")
+def host_escape(program: "TracedProgram"):
+    """The solve program must be a closed function of its inputs: a
+    callback (`jax.pure_callback`, `io_callback`, `jax.debug.print`)
+    re-enters Python mid-execution — unordered across devices, invisible
+    to the fingerprint's replay guarantee, and a trivial covert channel
+    for nondeterminism (the callback can read anything)."""
+    for idx, eqn in canonical_eqns(program.closed):
+        name = eqn.primitive.name
+        if name in _CALLBACK_PRIMS or "callback" in name:
+            yield idx, (f"`{name}` escapes to the host mid-program — "
+                        "compiled solve graphs must be closed over their "
+                        "inputs (jax.debug.print/pure_callback/io_callback "
+                        "do not belong in a mining program)")
+
+
+@graph_rule("GRAPH402", "warning",
+            "accumulating scatter without unique_indices")
+def scatter_accumulation(program: "TracedProgram"):
+    """`scatter-add`/`scatter-mul` with `unique_indices=False` lets XLA
+    combine colliding updates in any order — float accumulation order
+    then depends on backend scheduling, not on the program. If indices
+    are provably unique, say so at the call site
+    (`.at[...].add(..., unique_indices=True)`); otherwise the graph is
+    one backend change away from forking the determinism class."""
+    for idx, eqn in canonical_eqns(program.closed):
+        if eqn.primitive.name not in ("scatter-add", "scatter-mul"):
+            continue
+        if not eqn.params.get("unique_indices", False):
+            yield idx, (f"`{eqn.primitive.name}` with "
+                        "unique_indices=False — colliding float updates "
+                        "combine in backend-chosen order")
+
+
+_NAMED_REDUCTIONS = ("psum", "pmax", "pmin", "all_gather", "reduce_scatter",
+                     "all_to_all", "psum_scatter")
+
+
+@graph_rule("GRAPH403", "warning",
+            "named-axis reduction without canonical order")
+def named_axis_reduction_order(program: "TracedProgram"):
+    """Cross-chip reductions are deterministic only per (mesh layout,
+    axis order): a multi-axis `psum` whose axes are not in the
+    canonical AXIS_ORDER, or one using `axis_index_groups`, reduces in
+    an order the mesh tag does not pin — two builds of the same layout
+    could legally differ."""
+    from arbius_tpu.parallel.mesh import AXIS_ORDER
+
+    rank = {a: i for i, a in enumerate(AXIS_ORDER)}
+    for idx, eqn in canonical_eqns(program.closed):
+        if eqn.primitive.name not in _NAMED_REDUCTIONS:
+            continue
+        if eqn.params.get("axis_index_groups") is not None:
+            yield idx, (f"`{eqn.primitive.name}` with axis_index_groups — "
+                        "subgroup reductions are outside the canonical "
+                        "mesh-axis order the determinism class pins")
+            continue
+        axes = eqn.params.get("axes") or eqn.params.get("axis_name") or ()
+        if isinstance(axes, str):
+            axes = (axes,)
+        named = [a for a in axes if isinstance(a, str) and a in rank]
+        if len(named) > 1 and [rank[a] for a in named] != \
+                sorted(rank[a] for a in named):
+            yield idx, (f"`{eqn.primitive.name}` over axes "
+                        f"{tuple(named)} — not the canonical "
+                        f"{AXIS_ORDER} order; reduction order is part "
+                        "of program identity")
+
+
+@graph_rule("GRAPH404", "error", "float64 in a compiled program")
+def float64_in_graph(program: "TracedProgram"):
+    """The repo's numeric convention is f32 parameters / statistics and
+    bf16 MXU compute; an f64 value in a traced graph means someone
+    enabled x64 or leaked a host double into tracing — TPUs emulate f64
+    (slow) and the wider intermediate forks outputs against every
+    f32-class build."""
+    reported: set[int] = set()
+    for idx, eqn in canonical_eqns(program.closed):
+        for out in eqn.outvars:
+            dt = getattr(getattr(out, "aval", None), "dtype", None)
+            if dt is not None and str(dt) in ("float64", "complex128") \
+                    and idx not in reported:
+                reported.add(idx)
+                yield idx, (f"`{eqn.primitive.name}` produces {dt} — "
+                            "x64 leaked into the graph (repo convention "
+                            "is f32 statistics / bf16 compute)")
+
+
+_LP_ACCUM_PRIMS = ("reduce_sum", "reduce_prod", "cumsum", "cumprod",
+                   "cumlogsumexp", "psum")
+_LP_DTYPES = ("bfloat16", "float16")
+_ACCUM_COMBINERS = ("add", "mul")
+
+
+def _combiner_accumulates(eqn) -> bool:
+    """Generic `lax.reduce`: order-sensitive only when the combiner
+    body adds/multiplies (min/max combiners are exact in any order)."""
+    body = eqn.params.get("jaxpr")
+    inner = getattr(body, "jaxpr", body)
+    return any(e.primitive.name in _ACCUM_COMBINERS
+               for e in getattr(inner, "eqns", ()))
+
+
+@graph_rule("GRAPH405", "warning",
+            "reduction accumulating in sub-f32 precision")
+def low_precision_accumulation(program: "TracedProgram"):
+    """GroupNorm/softmax/variance statistics are computed in f32
+    throughout the zoo (models/common.py) because bf16 accumulation
+    order visibly moves the result. jnp-level sums auto-upcast half
+    dtypes, so a sub-f32 accumulation in a traced graph means someone
+    reached around that guard: a generic `lax.reduce` with an add/mul
+    combiner over bf16, a bf16 `psum` (cross-chip accumulation happens
+    in the wire dtype), or an explicitly downcast cumulative op."""
+    for idx, eqn in canonical_eqns(program.closed):
+        name = eqn.primitive.name
+        if not eqn.invars:
+            continue
+        accumulates = name in _LP_ACCUM_PRIMS or (
+            name == "reduce" and _combiner_accumulates(eqn))
+        if not accumulates:
+            continue
+        # multi-operand reductions (tuple psum, generic reduce with its
+        # init values) must be checked per operand, not just the first
+        for v in eqn.invars:
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None and str(dt) in _LP_DTYPES:
+                yield idx, (f"`{name}` accumulates in {dt} — statistics "
+                            "must be computed in float32 (GroupNorm32 / "
+                            "f32-softmax convention)")
+                break
+
+
+_SEED_PRIMS = ("random_seed", "threefry_seed")
+
+
+def _const_derived(closed) -> set[int]:
+    """ids of vars that are pure functions of program CONSTANTS — the
+    closed jaxpr's constvars plus anything computed only from literals/
+    const-derived vars (one forward pass per jaxpr; sub-jaxpr invars
+    inherit constness positionally when the arity matches, e.g. pjit/
+    scan, and stay conservatively non-const otherwise)."""
+    from jax.extend import core as jex_core
+
+    const: set[int] = {id(v) for v in closed.jaxpr.constvars}
+
+    def is_const(v) -> bool:
+        return isinstance(v, jex_core.Literal) or id(v) in const
+
+    def walk(jx) -> None:
+        for eqn in jx.eqns:
+            if eqn.invars and all(is_const(v) for v in eqn.invars):
+                for ov in eqn.outvars:
+                    const.add(id(ov))
+            for _, _, sub in _sub_jaxprs(eqn):
+                inner = _jaxpr_of(sub)
+                if isinstance(sub, jex_core.ClosedJaxpr):
+                    for cv in inner.constvars:
+                        const.add(id(cv))
+                if len(inner.invars) == len(eqn.invars):
+                    for pv, sv in zip(eqn.invars, inner.invars):
+                        if is_const(pv):
+                            const.add(id(sv))
+                walk(inner)
+
+    walk(closed.jaxpr)
+    return const
+
+
+@graph_rule("GRAPH406", "error",
+            "PRNG key seeded from a compile-time constant")
+def constant_prng_seed(program: "TracedProgram"):
+    """Every stochastic draw must chain from the task-seed input
+    (taskid2seed → PRNGKey → fold_in): a `random_seed` fed by a literal
+    — or by a closed-over constant, which traces as a constvar instead
+    of a literal — means some draw is the SAME for every task: at best
+    a fixed watermark, at worst the init noise no longer depends on the
+    task and every solve collides."""
+    from jax.extend import core as jex_core
+
+    const = _const_derived(program.closed)
+    for idx, eqn in canonical_eqns(program.closed):
+        if eqn.primitive.name not in _SEED_PRIMS:
+            continue
+        if eqn.invars and all(
+                isinstance(v, jex_core.Literal) or id(v) in const
+                for v in eqn.invars):
+            vals = ", ".join(
+                str(v.val) if isinstance(v, jex_core.Literal) else "const"
+                for v in eqn.invars)
+            yield idx, (f"PRNG key seeded from a constant ({vals}) — "
+                        "keys must derive from the threaded task-seed "
+                        "input via fold_in")

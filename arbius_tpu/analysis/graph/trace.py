@@ -1,0 +1,92 @@
+"""Trace-spec driver — registry entries to jaxprs, with obs reporting.
+
+`trace_spec` runs one TraceSpec's `build()` thunk and traces the
+returned callable with `jax.make_jaxpr` over its abstract arguments:
+no weights materialize, no program executes, no devices are touched
+(mesh specs trace over `parallel.abstract_mesh`), so a full-registry
+trace is a CPU-only, seconds-scale operation that tier-1 runs on every
+PR.
+
+Analysis health is reported through the ambient obs (`arbius_tpu.obs`),
+same pattern as the solver/retry instrumentation: when a node (or test)
+has an active `Obs`, `GET /metrics` exposes
+
+    arbius_graphlint_specs_traced_total
+    arbius_graphlint_trace_errors_total
+    arbius_graphlint_findings_total{rule}
+    arbius_graphlint_fingerprint_mismatch_total
+    arbius_graphlint_trace_seconds  (histogram, tagged by spec key)
+
+and standalone CLI runs (no active obs) pay a no-op.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from arbius_tpu.analysis.core import AnalysisError, Finding
+from arbius_tpu.models.trace_specs import TraceSpec
+from arbius_tpu.obs import current_obs
+
+# sub-second tiny-model traces up to minutes-scale full-topology ones
+TRACE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+@dataclass
+class TracedProgram:
+    """One spec's traced artifact: the ClosedJaxpr plus trace timing."""
+
+    spec: TraceSpec
+    closed: object   # jax ClosedJaxpr
+    seconds: float
+
+
+def trace_spec(spec: TraceSpec) -> TracedProgram:
+    """Build and trace one spec. Import of jax is deferred to here so
+    the CLI's argument/usage paths never pay (or require) it."""
+    import jax
+
+    obs = current_obs()
+    t0 = time.perf_counter()  # detlint: allow[DET101] obs timing only —
+    # the duration feeds the trace-seconds histogram, never the report
+    try:
+        fn, args = spec.build()
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:
+        if obs is not None:
+            obs.registry.counter(
+                "arbius_graphlint_trace_errors_total",
+                "trace-spec build/trace failures").inc()
+        raise AnalysisError(f"{spec.key}: trace failed: {e}") from e
+    dt = time.perf_counter() - t0  # detlint: allow[DET101] obs timing only
+    if obs is not None:
+        obs.registry.counter(
+            "arbius_graphlint_specs_traced_total",
+            "trace specs successfully traced to jaxprs").inc()
+        obs.registry.histogram(
+            "arbius_graphlint_trace_seconds",
+            "wall time to trace one spec to its jaxpr",
+            buckets=TRACE_BUCKETS).observe(dt, tag=spec.key)
+    return TracedProgram(spec=spec, closed=closed, seconds=dt)
+
+
+def trace_specs(specs: list[TraceSpec]) -> list[TracedProgram]:
+    return [trace_spec(s) for s in specs]
+
+
+def report_findings_obs(findings: list[Finding]) -> None:
+    """Count rule findings and fingerprint mismatches into the ambient
+    obs registry (no-op when none is active)."""
+    obs = current_obs()
+    if obs is None or not findings:
+        return
+    for f in findings:
+        if f.rule.startswith("GRAPH49"):
+            obs.registry.counter(
+                "arbius_graphlint_fingerprint_mismatch_total",
+                "golden fingerprint mismatches/missing/stale").inc()
+        else:
+            obs.registry.counter(
+                "arbius_graphlint_findings_total",
+                "graph rule findings", labelnames=("rule",)).inc(
+                rule=f.rule)

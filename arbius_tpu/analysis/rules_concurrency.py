@@ -18,8 +18,12 @@ Heuristics that keep the rule honest:
   - attributes assigned a threading primitive (Lock/Event/Condition/
     Thread/Queue) are exempt — their methods are the synchronization;
   - `__init__` writes are exempt (they happen-before `Thread.start()`);
-  - an access lexically inside `with self.<anything containing "lock">:`
-    counts as held.
+  - an access counts as held ONLY when lexically inside `with <x>:`
+    where `<x>` was assigned an actual lock constructor
+    (`threading.Lock/RLock/Condition/Semaphore/BoundedSemaphore`,
+    resolved through import aliases like every other rule) — a name
+    that merely *contains* "lock" (`self.blocked`, `self.clock`) is
+    not synchronization and no longer fools the rule.
 """
 from __future__ import annotations
 
@@ -31,12 +35,25 @@ _SYNC_SUFFIXES = ("Lock", "RLock", "Event", "Condition", "Semaphore",
                   "BoundedSemaphore", "Barrier", "Thread", "Queue",
                   "SimpleQueue", "local")
 
+# the subset whose `with` statement actually excludes other threads —
+# Event/Thread/Queue are sync primitives but not context-manager locks
+_LOCK_SUFFIXES = ("Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore")
+
+
+def _constructed_suffix(ctx: "FileContext", value: ast.AST) -> str | None:
+    """The canonical constructor name's last component if `value` is a
+    call to one (`threading.Lock()` → "Lock", via aliases too)."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = ctx.canonical(value.func)
+    if name is None:
+        return None
+    return name.rsplit(".", 1)[-1]
+
 
 def _is_sync_primitive(ctx: "FileContext", value: ast.AST) -> bool:
-    if not isinstance(value, ast.Call):
-        return False
-    name = ctx.canonical(value.func)
-    return name is not None and name.endswith(_SYNC_SUFFIXES)
+    return _constructed_suffix(ctx, value) in _SYNC_SUFFIXES
 
 
 def _self_attr(node: ast.AST) -> str | None:
@@ -46,21 +63,47 @@ def _self_attr(node: ast.AST) -> str | None:
     return None
 
 
-def _under_lock(ctx: FileContext, node: ast.AST) -> bool:
+def _collect_lock_names(ctx: FileContext) -> set[str]:
+    """Every name in the file that holds an actual lock: "self.<attr>"
+    for attribute assignments, bare names for locals/module globals.
+    One file-wide pass — lock attrs are almost always bound in
+    `__init__`, far from the `with` sites that reference them."""
+    locks: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None or \
+                _constructed_suffix(ctx, value) not in _LOCK_SUFFIXES:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is not None:
+                locks.add(f"self.{attr}")
+            elif isinstance(t, ast.Name):
+                locks.add(t.id)
+    return locks
+
+
+def _under_lock(ctx: FileContext, node: ast.AST,
+                lock_names: set[str]) -> bool:
     for anc in ctx.ancestors(node):
         if isinstance(anc, ast.With):
             for item in anc.items:
                 expr = item.context_expr
                 if isinstance(expr, ast.Call):
                     expr = expr.func
-                name = dotted_name(expr) or ""
-                if "lock" in name.lower():
+                name = dotted_name(expr)
+                if name is not None and name in lock_names:
                     return True
     return False
 
 
 class _ClassFacts:
-    def __init__(self, ctx: FileContext, cls: ast.ClassDef):
+    def __init__(self, ctx: FileContext, cls: ast.ClassDef,
+                 lock_names: set[str]):
         self.methods: dict[str, ast.FunctionDef] = {
             n.name: n for n in cls.body
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
@@ -100,12 +143,14 @@ class _ClassFacts:
                             self.sync_attrs.add(attr)
                             continue
                         self.writes.setdefault(attr, []).append(
-                            (mname, t.lineno, _under_lock(ctx, t)))
+                            (mname, t.lineno,
+                             _under_lock(ctx, t, lock_names)))
                 attr = _self_attr(node)
                 if attr is not None and \
                         isinstance(getattr(node, "ctx", None), ast.Load):
                     self.reads.setdefault(attr, []).append(
-                        (mname, node.lineno, _under_lock(ctx, node)))
+                        (mname, node.lineno,
+                         _under_lock(ctx, node, lock_names)))
 
     def reachable_from_targets(self) -> set[str]:
         seen: set[str] = set()
@@ -123,10 +168,11 @@ class _ClassFacts:
       "attribute shared between a thread target and other methods "
       "without a lock")
 def unlocked_shared_attribute(ctx: FileContext):
+    lock_names = _collect_lock_names(ctx)
     for cls in ast.walk(ctx.tree):
         if not isinstance(cls, ast.ClassDef):
             continue
-        facts = _ClassFacts(ctx, cls)
+        facts = _ClassFacts(ctx, cls, lock_names)
         if not facts.thread_targets:
             continue
         in_thread = facts.reachable_from_targets()

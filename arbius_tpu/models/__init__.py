@@ -1,1 +1,14 @@
-"""JAX/Flax model zoo — one family per reference template class."""
+"""JAX/Flax model zoo — one family per reference template class.
+
+Each pipeline module also exports `trace_specs()` — its jittable entry
+points as abstract, CPU-traceable `TraceSpec`s; `all_trace_specs()`
+aggregates the registry for graphlint (`arbius_tpu/analysis/graph`),
+which fingerprints every spec's XLA program against `goldens/graph/`.
+"""
+from arbius_tpu.models.trace_specs import (
+    TraceSpec,
+    all_trace_specs,
+    validate_specs,
+)
+
+__all__ = ["TraceSpec", "all_trace_specs", "validate_specs"]

@@ -260,3 +260,26 @@ class Kandinsky2Pipeline:
             # the previous chunk while the chip crunches this one
             return images
         return np.asarray(images)
+
+
+def trace_specs():
+    """graphlint trace spec (models/trace_specs.py): the whole
+    text→prior→decoder→MOVQ bucket program — one jitted graph, so one
+    fingerprint covers both published sub-pipelines."""
+    from arbius_tpu.models.trace_specs import TraceSpec
+    from arbius_tpu.schedulers import sampler_tag
+
+    def build():
+        p = Kandinsky2Pipeline(Kandinsky2Config.tiny())
+        shapes = jax.eval_shape(
+            lambda: p.init_params(height=64, width=64))
+        sds = jax.ShapeDtypeStruct
+        length = p.config.text.max_length
+        args = (shapes, sds((1, length), jnp.int32),
+                sds((1,), jnp.float32),
+                sds((1,), jnp.uint32), sds((1,), jnp.uint32))
+        return p.compiled_bucket(1, 64, 64, 2, "DDIM"), args
+
+    return [TraceSpec(model="kandinsky2", entry="txt2img",
+                      bucket=f"b1.64x64.{sampler_tag('DDIM', 2)}",
+                      mesh="single", dtype="bfloat16", build=build)]

@@ -127,3 +127,22 @@ class RVMPipeline:
             green[..., 1] = 1.0
             out = fgrs * alphas + green * (1.0 - alphas)
         return np.clip(np.rint(out * 255.0), 0, 255).astype(np.uint8)
+
+
+def trace_specs():
+    """graphlint trace spec (models/trace_specs.py): the frame-scan
+    matting program (ConvGRU carry over T frames) at tiny topology —
+    the only pipeline with no sampler/PRNG in its graph at all."""
+    from arbius_tpu.models.trace_specs import TraceSpec
+
+    def build():
+        p = RVMPipeline(RVMPipelineConfig.tiny())
+        shapes = jax.eval_shape(
+            lambda: p.init_params(height=64, width=64))
+        args = (shapes,
+                jax.ShapeDtypeStruct((2, 64, 64, 3), jnp.float32))
+        return p.compiled_bucket(2, 64, 64), args
+
+    return [TraceSpec(model="robust_video_matting", entry="matte",
+                      bucket="t2.64x64", mesh="single", dtype="bfloat16",
+                      build=build)]

@@ -267,3 +267,49 @@ class SD15Pipeline:
         if as_device:
             return images
         return np.asarray(images)
+
+
+def trace_specs():
+    """graphlint trace specs (models/trace_specs.py): the anythingv3
+    bucket program at tiny topology, in both compute dtypes and under
+    the two scheduler shapes (plain + ancestral-noise), all abstract —
+    params via eval_shape, no weights, CPU-traceable in seconds."""
+    import dataclasses
+
+    from arbius_tpu.models.trace_specs import TraceSpec
+    from arbius_tpu.schedulers import sampler_tag
+
+    def build_bucket(dtype: str, steps: int, scheduler: str):
+        def build():
+            cfg = SD15Config.tiny()
+            if dtype != "bfloat16":
+                cfg = SD15Config(
+                    unet=dataclasses.replace(cfg.unet, dtype=dtype),
+                    vae=dataclasses.replace(cfg.vae, dtype=dtype),
+                    text=dataclasses.replace(cfg.text, dtype=dtype))
+            p = SD15Pipeline(cfg)
+            lh = 64 // p.VAE_FACTOR
+            shapes = jax.eval_shape(p._init_fn(lh, lh),
+                                    jax.random.PRNGKey(0))
+            sds = jax.ShapeDtypeStruct
+            length = cfg.text.max_length
+            args = (shapes,
+                    sds((1, length), jnp.int32), sds((1, length), jnp.int32),
+                    sds((1,), jnp.float32),
+                    sds((1,), jnp.uint32), sds((1,), jnp.uint32))
+            return p.compiled_bucket(1, 64, 64, steps, scheduler), args
+
+        return build
+
+    return [
+        TraceSpec(model="anythingv3", entry="txt2img",
+                  bucket=f"b1.64x64.{sampler_tag('DDIM', 2)}",
+                  mesh="single", dtype=dtype,
+                  build=build_bucket(dtype, 2, "DDIM"))
+        for dtype in ("bfloat16", "float32")
+    ] + [
+        TraceSpec(model="anythingv3", entry="txt2img",
+                  bucket=f"b1.64x64.{sampler_tag('K_EULER_ANCESTRAL', 2)}",
+                  mesh="single", dtype="bfloat16",
+                  build=build_bucket("bfloat16", 2, "K_EULER_ANCESTRAL")),
+    ]

@@ -236,3 +236,49 @@ class Text2VideoPipeline:
                  jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
                  jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32))
         return np.asarray(out)
+
+
+def trace_specs():
+    """graphlint trace specs (models/trace_specs.py): the UNet3D video
+    bucket single-device AND under a dp×sp×tp shard_map layout. The
+    mesh variant traces over `parallel.abstract_mesh`, so the ring
+    attention / halo exchange collectives land in the fingerprint with
+    no physical devices (and no device ids) involved — mesh layout is
+    part of the determinism class (docs/determinism.md) and therefore
+    part of the golden key."""
+    from arbius_tpu.models.trace_specs import TraceSpec
+    from arbius_tpu.parallel import MeshSpec, abstract_mesh, mesh_tag
+    from arbius_tpu.schedulers import sampler_tag
+
+    def build_single():
+        p = Text2VideoPipeline(Text2VideoConfig.tiny())
+        return _bucket_args(p, batch=1)
+
+    def build_sharded():
+        mesh = abstract_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        p = Text2VideoPipeline(Text2VideoConfig.tiny(sp_axis="sp"),
+                               mesh=mesh)
+        return _bucket_args(p, batch=2)
+
+    def _bucket_args(p, batch):
+        shapes = jax.eval_shape(
+            lambda: p.init_params(frames=2, height=64, width=64))
+        sds = jax.ShapeDtypeStruct
+        length = p.config.text.max_length
+        args = (shapes,
+                sds((batch, length), jnp.int32),
+                sds((batch, length), jnp.int32),
+                sds((batch,), jnp.float32),
+                sds((batch,), jnp.uint32), sds((batch,), jnp.uint32))
+        return p.compiled_bucket(batch, 2, 64, 64, 2, "DDIM"), args
+
+    bucket = f"f2.64x64.{sampler_tag('DDIM', 2)}"
+    sharded_tag = mesh_tag(abstract_mesh(MeshSpec(dp=2, sp=2, tp=2)))
+    return [
+        TraceSpec(model="zeroscopev2xl", entry="txt2vid",
+                  bucket=f"b1.{bucket}", mesh="single", dtype="bfloat16",
+                  build=build_single),
+        TraceSpec(model="zeroscopev2xl", entry="txt2vid",
+                  bucket=f"b2.{bucket}", mesh=sharded_tag,
+                  dtype="bfloat16", build=build_sharded),
+    ]

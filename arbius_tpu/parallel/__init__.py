@@ -20,8 +20,10 @@ Axes:
 """
 from arbius_tpu.parallel.mesh import (
     MeshSpec,
+    abstract_mesh,
     build_mesh,
     local_mesh,
+    mesh_tag,
 )
 from arbius_tpu.parallel.sharding import (
     DEFAULT_TP_RULES,
@@ -42,8 +44,10 @@ from arbius_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 __all__ = [
     "DEFAULT_TP_RULES",
     "MeshSpec",
+    "abstract_mesh",
     "build_mesh",
     "local_mesh",
+    "mesh_tag",
     "batch_sharding",
     "replicated",
     "shard_params",

@@ -73,6 +73,43 @@ def build_mesh(spec: MeshSpec | None = None, devices=None) -> Mesh:
     return Mesh(arr, AXIS_ORDER)
 
 
+def abstract_mesh(spec: MeshSpec | None = None,
+                  n_devices: int | None = None):
+    """Device-free mesh for TRACING dp/sp/tp layouts (graphlint).
+
+    `jax.sharding.AbstractMesh` carries only the (axis, size) shape, so
+    `shard_map`-built programs can be traced to jaxprs on a host with no
+    accelerators — and no device ids can leak into the canonicalized
+    program text that graphlint fingerprints. Not placeable: anything
+    that actually executes needs `build_mesh`.
+
+    Axis sizes must be explicit (the -1 wildcard needs a real device
+    count to resolve against; pass `n_devices` to use it).
+    """
+    from jax.sharding import AbstractMesh
+
+    spec = spec or MeshSpec(dp=1)
+    if n_devices is not None:
+        sizes = spec.resolve(n_devices)
+    else:
+        sizes = {"pp": spec.pp, "dp": spec.dp, "sp": spec.sp, "tp": spec.tp}
+        bad = {k: v for k, v in sizes.items() if v < 1}
+        if bad:
+            raise ValueError(
+                f"abstract_mesh needs explicit axis sizes (got {bad}); "
+                "pass n_devices to resolve a -1 wildcard")
+    return AbstractMesh(tuple((a, sizes[a]) for a in AXIS_ORDER))
+
+
+def mesh_tag(mesh) -> str:
+    """Filename-safe layout tag for a (concrete or abstract) mesh:
+    non-trivial axes only, canonical order — ``dp2.sp2.tp2``; the
+    all-ones layout is ``single``. Part of graphlint's golden keys."""
+    parts = [f"{a}{mesh.shape[a]}" for a in AXIS_ORDER
+             if mesh.shape.get(a, 1) > 1]
+    return ".".join(parts) if parts else "single"
+
+
 def local_mesh(n: int | None = None, spec: MeshSpec | None = None) -> Mesh:
     """Mesh over the first n local devices (testing / partial-slice use)."""
     devs = jax.devices()

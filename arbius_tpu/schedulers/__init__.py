@@ -3,7 +3,12 @@ from arbius_tpu.schedulers.diffusion import (
     NUM_TRAIN_TIMESTEPS,
     alphas_cumprod,
 )
-from arbius_tpu.schedulers.samplers import SAMPLER_NAMES, Sampler, get_sampler
+from arbius_tpu.schedulers.samplers import (
+    SAMPLER_NAMES,
+    Sampler,
+    get_sampler,
+    sampler_tag,
+)
 
 __all__ = [
     "NUM_TRAIN_TIMESTEPS",
@@ -11,4 +16,5 @@ __all__ = [
     "Sampler",
     "alphas_cumprod",
     "get_sampler",
+    "sampler_tag",
 ]

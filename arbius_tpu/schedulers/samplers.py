@@ -33,7 +33,7 @@ from arbius_tpu.schedulers.diffusion import (
     linspace_timesteps,
 )
 
-__all__ = ["get_sampler", "SAMPLER_NAMES", "Sampler"]
+__all__ = ["get_sampler", "SAMPLER_NAMES", "Sampler", "sampler_tag"]
 
 
 class Sampler:
@@ -327,3 +327,15 @@ def get_sampler(name: str, num_steps: int) -> Sampler:
     if num_steps < 1 or num_steps > NUM_TRAIN_TIMESTEPS:
         raise ValueError(f"num_steps must be in [1, {NUM_TRAIN_TIMESTEPS}]")
     return cls(num_steps)
+
+
+def sampler_tag(name: str, num_steps: int) -> str:
+    """Filename-safe tag identifying one (sampler, num_steps) program
+    slice — e.g. ``ddim.s2``. The sampler's static tables are baked into
+    the traced graph as constants, so (name, num_steps) is part of XLA
+    program identity; graphlint trace specs (models/trace_specs.py) use
+    this tag inside their shape-bucket keys and golden filenames."""
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown scheduler {name!r}; expected one of {SAMPLER_NAMES}")
+    return f"{name.lower().replace('_', '-')}.s{int(num_steps)}"

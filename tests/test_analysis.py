@@ -268,6 +268,58 @@ def test_conc301_only_threaded_classes_analyzed():
         "    def b(self):\n        return self.x\n")
 
 
+def test_conc301_lock_substring_names_do_not_count_as_held():
+    # `clock` contains "lock" but holds no lock — a `with self.clock:`
+    # block is not synchronization and must not hide the race (the old
+    # substring heuristic was fooled by blocked/clock/lockfile names)
+    src = _THREADED.replace(
+        "        self.state = s",
+        "        with self.clock:\n            self.state = s",
+    ).replace(
+        "        while self.state != \"stop\":\n            pass",
+        "        with self.clock:\n            s = self.state",
+    ).replace(
+        "        self.state = \"idle\"",
+        "        self.state = \"idle\"\n"
+        "        self.clock = wallclock.Clock()",
+    )
+    assert rules_of(check(src)) == ["CONC301"]
+
+
+def test_conc301_lock_recognized_through_import_alias():
+    # an actual RLock bound via a from-import alias IS synchronization —
+    # constructor recognition resolves canonical names like the other
+    # rules, not literal spellings
+    src = _THREADED.replace(
+        "import threading",
+        "import threading\nfrom threading import RLock as _RL",
+    ).replace(
+        "        self.state = s",
+        "        with self._guard:\n            self.state = s",
+    ).replace(
+        "        while self.state != \"stop\":\n            pass",
+        "        with self._guard:\n            s = self.state",
+    ).replace(
+        "        self.state = \"idle\"",
+        "        self.state = \"idle\"\n        self._guard = _RL()",
+    )
+    assert not check(src)
+
+
+def test_conc301_module_level_lock_recognized():
+    src = _THREADED.replace(
+        "import threading",
+        "import threading\n_IO_LOCK = threading.Lock()",
+    ).replace(
+        "        self.state = s",
+        "        with _IO_LOCK:\n            self.state = s",
+    ).replace(
+        "        while self.state != \"stop\":\n            pass",
+        "        with _IO_LOCK:\n            s = self.state",
+    )
+    assert not check(src)
+
+
 # -- suppressions, enforce, LINT001 -----------------------------------------
 
 def test_inline_suppression_same_line_and_above():

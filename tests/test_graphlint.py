@@ -1,0 +1,525 @@
+"""graphlint — trace-spec registry, GRAPH4xx rules, canonical
+fingerprints, and the tier-1 golden gate over `goldens/graph/`.
+
+The self-check here is the actual guardrail: every registered pipeline
+entry point is re-traced on CPU and compared against the checked-in
+golden fingerprints — change a traced XLA program (dtype, reduction,
+callback, schedule table) and THIS file goes red with a structural
+diff. The perturbation tests prove the gate fails closed rather than
+assuming it.
+"""
+import json
+import math
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+GOLDENS_DIR = str(REPO / "goldens" / "graph")
+
+sys.path.insert(0, str(REPO / "tools"))
+
+import jax
+import jax.numpy as jnp
+
+from arbius_tpu.analysis.graph import (
+    audit,
+    canonical_lines,
+    diff_summaries,
+    fingerprint,
+    run_rules,
+    summarize,
+    trace_spec,
+)
+from arbius_tpu.analysis.graph import goldens as goldens_mod
+from arbius_tpu.analysis.graph.cli import main as cli_main
+from arbius_tpu.analysis.graph.trace import TracedProgram
+from arbius_tpu.models import TraceSpec, all_trace_specs, validate_specs
+
+
+def synthetic_spec(fn, args, *, entry="fn", allow=()) -> TraceSpec:
+    return TraceSpec(model="synthetic", entry=entry, bucket="b1",
+                     mesh="single", dtype="float32",
+                     build=lambda: (fn, args), allow=allow)
+
+
+def traced(fn, args, **kw) -> TracedProgram:
+    return trace_spec(synthetic_spec(fn, args, **kw))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_covers_every_pipeline_family():
+    specs = all_trace_specs()
+    models = {s.model for s in specs}
+    assert {"anythingv3", "kandinsky2", "robust_video_matting",
+            "zeroscopev2xl"} <= models
+    # the identity axes the ISSUE names: dtype variants, mesh variants
+    assert {s.dtype for s in specs} >= {"bfloat16", "float32"}
+    assert any(s.mesh != "single" for s in specs), \
+        "a dp/sp/tp shard_map layout must be fingerprinted"
+    assert len({s.key for s in specs}) == len(specs)
+
+
+def test_registry_validation_rejects_bad_specs():
+    ok = synthetic_spec(lambda x: x, (jnp.float32(0),))
+    with pytest.raises(ValueError, match="duplicate"):
+        validate_specs([ok, ok])
+    with pytest.raises(ValueError, match="filename-safe"):
+        validate_specs([TraceSpec(model="Bad/Name", entry="e", bucket="b",
+                                  mesh="single", dtype="float32",
+                                  build=lambda: None)])
+    with pytest.raises(ValueError, match="reason"):
+        validate_specs([TraceSpec(model="m", entry="e", bucket="b",
+                                  mesh="single", dtype="float32",
+                                  build=lambda: None,
+                                  allow=(("GRAPH401", ""),))])
+
+
+# -- the tier-1 self-check (the actual guardrail) ---------------------------
+
+@pytest.fixture(scope="session")
+def full_audit_findings():
+    return audit(goldens_dir=GOLDENS_DIR)
+
+
+def test_package_self_check_clean_against_goldens(full_audit_findings):
+    assert full_audit_findings == [], (
+        "graphlint found rule findings or golden fingerprint drift — "
+        "fix the graph change, or (if it is an intended program change) "
+        "run tools/graphlint.py --golden-update and justify the diff "
+        "per goldens/graph/README.md:\n"
+        + "\n".join(f.text() for f in full_audit_findings))
+
+
+def test_goldens_dir_matches_registry_exactly():
+    keys = {s.key for s in all_trace_specs()}
+    assert set(goldens_mod.recorded_keys(GOLDENS_DIR)) == keys
+
+
+# -- fingerprint stability & canonicalization -------------------------------
+
+def test_fingerprint_byte_identical_rerun():
+    spec = next(s for s in all_trace_specs()
+                if s.model == "robust_video_matting")
+    a = trace_spec(spec)
+    b = trace_spec(spec)
+    assert fingerprint(a.closed) == fingerprint(b.closed)
+    assert list(canonical_lines(a.closed)) == list(canonical_lines(b.closed))
+    assert summarize(a.closed) == summarize(b.closed)
+
+
+def test_canonicalization_ignores_names_and_metadata():
+    # alpha-equivalent programs spelled with different python
+    # identifiers AND different jit names: the raw jaxpr text differs
+    # (the pjit `name=` metadata), the canonical fingerprint must not
+    def helper_one(a, b):
+        c = a + b
+        return c * a
+
+    def completely_different_name(x, y):
+        t = x + y
+        return t * x
+
+    args = (jax.ShapeDtypeStruct((4,), jnp.float32),
+            jax.ShapeDtypeStruct((4,), jnp.float32))
+    ja = jax.make_jaxpr(jax.jit(helper_one))(*args)
+    jb = jax.make_jaxpr(jax.jit(completely_different_name))(*args)
+    assert str(ja) != str(jb), "test is vacuous: texts already identical"
+    assert fingerprint(ja) == fingerprint(jb)
+
+
+def test_canonicalization_keeps_argument_order_identity():
+    # NOT alpha-equivalent: the sub-program consumes its operands in a
+    # different order — a canonicalizer that renames vars without
+    # emitting binder order would merge these
+    def f(a, b):
+        return jax.jit(lambda x, y: x - y)(a, b)
+
+    def g(a, b):
+        return jax.jit(lambda x, y: x - y)(b, a)
+
+    args = (jax.ShapeDtypeStruct((4,), jnp.float32),
+            jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert fingerprint(jax.make_jaxpr(f)(*args)) != \
+        fingerprint(jax.make_jaxpr(g)(*args))
+
+
+def test_fingerprint_sees_constant_values():
+    # same graph shape, different baked-in table (a "sampler schedule
+    # edit"): op histograms match, fingerprints must not
+    table1 = jnp.arange(8, dtype=jnp.float32)
+    table2 = jnp.arange(8, dtype=jnp.float32) * 2.0
+
+    def use(table):
+        return lambda i: table[i] + 1.0
+
+    arg = (jax.ShapeDtypeStruct((), jnp.int32),)
+    ja = jax.make_jaxpr(use(table1))(*arg)
+    jb = jax.make_jaxpr(use(table2))(*arg)
+    assert summarize(ja)["primitives"] == summarize(jb)["primitives"]
+    assert fingerprint(ja) != fingerprint(jb)
+    assert "constants" in " ".join(
+        diff_summaries(summarize(ja), summarize(jb)))
+
+
+# -- GRAPH4xx rules ---------------------------------------------------------
+
+def test_graph401_host_callback():
+    def noisy(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * 2
+
+    prog = traced(noisy, (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    hits = run_rules(prog)
+    assert rules_of(hits) == ["GRAPH401"]
+    assert "debug_callback" in hits[0].message
+
+    clean = traced(lambda x: x * 2,
+                   (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    assert not run_rules(clean)
+
+
+def test_graph402_scatter_add_unique_indices():
+    def nonunique(x, idx, upd):
+        return x.at[idx].add(upd)
+
+    def unique(x, idx, upd):
+        return x.at[idx].add(upd, unique_indices=True)
+
+    args = (jax.ShapeDtypeStruct((8,), jnp.float32),
+            jax.ShapeDtypeStruct((4,), jnp.int32),
+            jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert rules_of(run_rules(traced(nonunique, args))) == ["GRAPH402"]
+    assert not run_rules(traced(unique, args))
+
+
+def test_graph403_named_axis_reduction_order():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from arbius_tpu.parallel import MeshSpec, abstract_mesh
+
+    mesh = abstract_mesh(MeshSpec(dp=2, sp=1, tp=2))
+
+    def make(axes):
+        return shard_map(lambda x: jax.lax.psum(x, axes), mesh=mesh,
+                         in_specs=P("dp"), out_specs=P(),
+                         check_rep=False)
+
+    args = (jax.ShapeDtypeStruct((8, 4), jnp.float32),)
+    bad = run_rules(traced(make(("tp", "dp")), args))
+    assert rules_of(bad) == ["GRAPH403"]
+    assert "canonical" in bad[0].message
+    assert not run_rules(traced(make(("dp", "tp")), args))
+
+
+def test_graph404_float64_in_graph():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        def drift(x):
+            return jnp.sum(x.astype(jnp.float64))
+
+        prog = traced(drift, (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    hits = run_rules(prog)
+    assert "GRAPH404" in rules_of(hits)
+    assert all(f.severity == "error" for f in hits
+               if f.rule == "GRAPH404")
+
+
+def test_graph405_bf16_accumulation():
+    def lost_upcast(x):
+        # a raw lax.reduce with an add combiner in bf16 — the exact
+        # accumulation jnp.sum would have auto-upcast to f32
+        return jax.lax.reduce(x, jnp.zeros((), x.dtype), jax.lax.add,
+                              (0,))
+
+    def bf16_min(x):
+        # min/max combiners are exact in any order: not flagged
+        return jax.lax.reduce(x, jnp.full((), jnp.inf, x.dtype),
+                              jax.lax.min, (0,))
+
+    args = (jax.ShapeDtypeStruct((16,), jnp.bfloat16),)
+    hits = run_rules(traced(lost_upcast, args))
+    assert rules_of(hits) == ["GRAPH405"]
+    assert "bfloat16" in hits[0].message
+    assert not run_rules(traced(bf16_min, args))
+    # jnp.sum over bf16 is auto-upcast by jax itself — must NOT fire
+    assert not run_rules(traced(
+        lambda x: jnp.sum(x), args))
+
+
+def test_graph406_constant_prng_seed():
+    def watermark(x):
+        key = jax.random.PRNGKey(42)
+        return x + jax.random.normal(key, x.shape)
+
+    def threaded(x, seed):
+        key = jax.random.PRNGKey(seed)
+        return x + jax.random.normal(key, x.shape)
+
+    xs = jax.ShapeDtypeStruct((4,), jnp.float32)
+    hits = run_rules(traced(watermark, (xs,)))
+    assert rules_of(hits) == ["GRAPH406"]
+    assert "42" in hits[0].message
+    assert not run_rules(traced(
+        threaded, (xs, jax.ShapeDtypeStruct((), jnp.uint32))))
+
+
+def test_graph406_closed_over_constant_seed():
+    # a seed closed over from module scope traces as a CONSTVAR, not a
+    # literal — the rule must follow const-derivation, not just inline
+    # literals
+    seed = jnp.uint32(1337)
+
+    def watermark(x):
+        key = jax.random.PRNGKey(seed)
+        return x + jax.random.normal(key, x.shape)
+
+    hits = run_rules(traced(watermark,
+                            (jax.ShapeDtypeStruct((4,), jnp.float32),)))
+    assert rules_of(hits) == ["GRAPH406"]
+    assert "const" in hits[0].message
+
+
+def test_graph405_checks_every_reduction_operand():
+    # tuple psum: the bf16 half of a mixed (f32, bf16) reduction must
+    # not hide behind the f32 first operand
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from arbius_tpu.parallel import MeshSpec, abstract_mesh
+
+    mesh = abstract_mesh(MeshSpec(dp=2, sp=1, tp=1))
+    f = shard_map(lambda a, b: jax.lax.psum((a, b), "dp"), mesh=mesh,
+                  in_specs=(P("dp"), P("dp")), out_specs=(P(), P()),
+                  check_rep=False)
+    hits = run_rules(traced(f, (jax.ShapeDtypeStruct((8,), jnp.float32),
+                                jax.ShapeDtypeStruct((8,), jnp.bfloat16))))
+    assert "GRAPH405" in rules_of(hits)
+
+
+def test_spec_waiver_mirrors_pragma_semantics():
+    def noisy(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * 2
+
+    args = (jax.ShapeDtypeStruct((4,), jnp.float32),)
+    waived = traced(noisy, args,
+                    allow=(("GRAPH401", "debug build diagnostic"),))
+    assert not run_rules(waived)
+    # waiving one rule must not waive others
+    assert rules_of(run_rules(traced(noisy, args,
+                                     allow=(("GRAPH402", "x"),)))) == \
+        ["GRAPH401"]
+
+
+def test_finding_anchors_to_canonical_eqn():
+    def noisy(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * 2
+
+    prog = traced(noisy, (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    hit = run_rules(prog)[0]
+    lines = list(canonical_lines(prog.closed))
+    assert any(line.startswith(f"{hit.line}: ") and "callback" in line
+               for line in lines), \
+        "finding line must index into the canonical text"
+
+
+# -- the golden gate fails closed -------------------------------------------
+
+@pytest.fixture()
+def bf16_groupnorm(monkeypatch):
+    """Flip every GroupNorm in the SD-1.5 stack to ACTIVATION-dtype
+    statistics — the exact regression the gate exists for."""
+    import flax.linen as nn
+
+    from arbius_tpu.models import common as common_mod
+    from arbius_tpu.models.sd15 import unet as unet_mod
+    from arbius_tpu.models.sd15 import vae as vae_mod
+
+    class Bf16StatsGN(nn.Module):
+        num_groups: int = 32
+        epsilon: float = 1e-5
+
+        @nn.compact
+        def __call__(self, x):
+            g = math.gcd(x.shape[-1], self.num_groups)
+            b, h, w, c = x.shape
+            xg = x.reshape(b, h, w, g, c // g)
+            n = h * w * (c // g)
+            zero = jnp.zeros((), x.dtype)
+            s = jax.lax.reduce(xg, zero, jax.lax.add, (1, 2, 4))
+            mean = (s / n)[:, None, None, :, None]
+            s2 = jax.lax.reduce(xg * xg, zero, jax.lax.add, (1, 2, 4))
+            var = (s2 / n)[:, None, None, :, None] - mean * mean
+            out = (xg - mean) * jax.lax.rsqrt(var + self.epsilon)
+            return out.reshape(b, h, w, c)
+
+    for mod in (common_mod, unet_mod, vae_mod):
+        monkeypatch.setattr(mod, "GroupNorm32", Bf16StatsGN)
+    return Bf16StatsGN
+
+
+def test_injected_bf16_groupnorm_fails_the_gate(bf16_groupnorm):
+    """ISSUE acceptance: an intentionally perturbed graph (GroupNorm
+    statistics flipped to bf16) must (a) trip GRAPH405 and (b) mismatch
+    the golden fingerprint with a readable structural diff."""
+    spec = next(s for s in all_trace_specs()
+                if s.model == "anythingv3" and s.dtype == "bfloat16"
+                and "ddim" in s.bucket)
+    prog = trace_spec(spec)
+
+    hits = run_rules(prog)
+    assert "GRAPH405" in rules_of(hits), \
+        "bf16 statistics must trip the low-precision accumulation rule"
+
+    gate = goldens_mod.check([prog], GOLDENS_DIR, all_keys_expected=False)
+    assert rules_of(gate) == ["GRAPH490"]
+    msg = gate[0].message
+    assert "reduce[bfloat16]" in msg, \
+        f"mismatch message must carry the structural diff, got: {msg}"
+    assert gate[0].enforced, "golden-gate findings are never waivable"
+
+
+def test_golden_docs_are_deterministic(tmp_path):
+    prog = traced(lambda x: x * 2 + 1,
+                  (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    d = str(tmp_path)
+    path1 = goldens_mod.write_golden(d, goldens_mod.golden_doc(prog))
+    first = pathlib.Path(path1).read_bytes()
+    goldens_mod.write_golden(d, goldens_mod.golden_doc(prog))
+    assert pathlib.Path(path1).read_bytes() == first
+    assert not goldens_mod.check([prog], d)
+
+
+def test_golden_gate_missing_and_stale(tmp_path):
+    d = str(tmp_path)
+    prog = traced(lambda x: x * 2,
+                  (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    # no golden recorded: fail closed
+    assert rules_of(goldens_mod.check([prog], d)) == ["GRAPH491"]
+    goldens_mod.update([prog], d)
+    assert not goldens_mod.check([prog], d)
+    # a golden whose spec vanished: stale, also fatal on full runs
+    other = traced(lambda x: x + 1,
+                   (jax.ShapeDtypeStruct((4,), jnp.float32),),
+                   entry="gone")
+    goldens_mod.write_golden(d, goldens_mod.golden_doc(other))
+    assert rules_of(goldens_mod.check([prog], d)) == ["GRAPH492"]
+    # ...but expected on --spec-filtered runs
+    assert not goldens_mod.check([prog], d, all_keys_expected=False)
+    # full update prunes the stale file; partial update must not
+    goldens_mod.update([prog], d)
+    assert rules_of(goldens_mod.check([prog], d)) == []
+    goldens_mod.write_golden(d, goldens_mod.golden_doc(other))
+    goldens_mod.update([prog], d, prune=False)
+    assert set(goldens_mod.recorded_keys(d)) == \
+        {prog.spec.key, other.spec.key}
+
+
+def test_malformed_golden_is_usage_error(tmp_path):
+    from arbius_tpu.analysis.core import AnalysisError
+
+    prog = traced(lambda x: x * 2,
+                  (jax.ShapeDtypeStruct((4,), jnp.float32),))
+    path = goldens_mod.golden_path(str(tmp_path), prog.spec.key)
+    pathlib.Path(path).write_text(json.dumps({"version": 99}))
+    with pytest.raises(AnalysisError, match="malformed"):
+        goldens_mod.check([prog], str(tmp_path))
+
+
+# -- CLI + tools layer ------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert cli_main(["--list"]) == 0
+    assert cli_main(["--spec", "no-such-spec"]) == 2
+    assert cli_main(["--select", "NOPE", "--spec", "x"]) == 2
+    assert cli_main(["--help"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_spec_filtered_run_and_update(tmp_path, capsys):
+    d = str(tmp_path / "g")
+    # empty goldens dir → missing-golden finding → exit 1
+    assert cli_main(["--spec", "robust_video_matting",
+                     "--goldens", d]) == 1
+    out = capsys.readouterr()
+    assert "GRAPH491" in out.out
+    # record, then clean
+    assert cli_main(["--spec", "robust_video_matting", "--goldens", d,
+                     "--golden-update"]) == 0
+    assert cli_main(["--spec", "robust_video_matting",
+                     "--goldens", d]) == 0
+    # JSON shape matches the detlint document
+    assert cli_main(["--spec", "robust_video_matting", "--goldens",
+                     str(tmp_path / "empty"), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == 1
+    assert [f["rule"] for f in doc["findings"]] == ["GRAPH491"]
+    # --golden-update honors --json too (clean update → empty document)
+    assert cli_main(["--spec", "robust_video_matting", "--goldens", d,
+                     "--golden-update", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc == {"version": 1, "findings": []}
+
+
+def test_audit_subset_does_not_flag_other_goldens_stale():
+    from arbius_tpu.analysis.graph import audit
+
+    spec = next(s for s in all_trace_specs()
+                if s.model == "robust_video_matting")
+    assert audit([spec], goldens_dir=GOLDENS_DIR) == []
+
+
+def test_tools_graphlint_shares_lint_main(capsys):
+    import graphlint as graphlint_tool
+
+    assert graphlint_tool.main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "robust_video_matting" in out
+
+
+def test_module_entrypoint_runs():
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "arbius_tpu.analysis.graph",
+         "--spec", "robust_video_matting", "--goldens", GOLDENS_DIR],
+        capture_output=True, text=True, env=env, cwd=str(REPO))
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+# -- obs integration --------------------------------------------------------
+
+def test_obs_reports_graphlint_health(tmp_path):
+    from arbius_tpu.obs import Obs, use_obs
+
+    def noisy(x):
+        jax.debug.print("x = {x}", x=x)
+        return x * 2
+
+    obs = Obs()
+    with use_obs(obs):
+        audit([synthetic_spec(noisy,
+                              (jax.ShapeDtypeStruct((4,), jnp.float32),))],
+              goldens_dir=str(tmp_path))
+    reg = obs.registry
+    assert reg.counter("arbius_graphlint_specs_traced_total").value() == 1
+    assert reg.counter("arbius_graphlint_findings_total",
+                       labelnames=("rule",)).value(rule="GRAPH401") == 1
+    # missing golden counts as a fingerprint-gate failure
+    assert reg.counter(
+        "arbius_graphlint_fingerprint_mismatch_total").value() == 1
+    hist = reg.get("arbius_graphlint_trace_seconds")
+    assert hist is not None and hist.count() == 1
+    render = reg.render()
+    assert "arbius_graphlint_specs_traced_total 1" in render

@@ -1,18 +1,41 @@
 """Shared arg/output plumbing for the operator tools in tools/.
 
 Every tool renders terminal tables and builds its parser the same way,
-so the formatting lives once here (obs_dump.py and detlint.py are the
-customers; new tools should start from these):
+so the formatting lives once here (obs_dump.py, detlint.py and
+graphlint.py are the customers; new tools should start from these):
 
     make_parser(prog, doc)   argparse.ArgumentParser with the tool's
                              module docstring as raw description
     kv_table(mapping)        aligned `key  value` lines, keys sorted,
                              floats rendered %.6g — the obs metrics view
-                             and the detlint per-rule summary
+                             and the lint per-rule summaries
+    lint_main(...)           the whole linter-tool main(): parse,
+                             collect, render, per-rule stderr summary,
+                             exit-code mapping
+
+The lint exit-code contract (0 clean / 1 findings / 2 usage) and the
+stable JSON report document are defined ONCE, in
+`arbius_tpu.analysis.cli`, and re-exported here so the tools and the
+`python -m` module entry points cannot drift apart — detlint.py and
+graphlint.py are both ~10-line shells over `lint_main`.
 """
 from __future__ import annotations
 
 import argparse
+import os
+import sys
+
+# `python tools/<tool>.py` puts tools/ (not the repo root) on sys.path;
+# the shared contract below lives in the package, so resolve the root
+# here once instead of in every tool
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from arbius_tpu.analysis.cli import (  # noqa: F401,E402 — re-exported contract
+    EXIT_CLEAN,
+    EXIT_FINDINGS,
+    EXIT_USAGE,
+    render_json as emit_json_report,
+)
 
 
 def make_parser(prog: str, doc: str | None) -> argparse.ArgumentParser:
@@ -33,3 +56,29 @@ def kv_table(mapping: dict) -> str:
             v = f"{v:.6g}"
         lines.append(f"{str(k).ljust(width)}  {v}")
     return "\n".join(lines)
+
+
+def lint_main(prog: str, doc: str | None, build_arg_parser, collect,
+              render, argv=None) -> int:
+    """The one linter-tool main loop. `build_arg_parser`/`collect`/
+    `render` are the module CLI's own functions (arbius_tpu.analysis.cli
+    or .graph.cli), so tool and `python -m` module stay behavior-
+    identical; this adds only the tool niceties (docstring help, the
+    per-rule triage table on stderr) around the shared exit contract."""
+    parser = build_arg_parser(make_parser(prog, doc))
+    try:
+        ns = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse exits 2 on usage error, 0 on --help — preserve both
+        return int(e.code or 0)
+    rc, findings = collect(ns)
+    if rc is not None:
+        return rc
+    render(ns, findings, sys.stdout)
+    if findings and not ns.json:
+        # quick triage view: which rules are firing, how often
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print("\nfindings by rule:\n" + kv_table(counts), file=sys.stderr)
+    return EXIT_FINDINGS if findings else EXIT_CLEAN

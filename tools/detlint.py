@@ -12,7 +12,8 @@ lives in docs/static-analysis.md):
 Exit codes: 0 clean / 1 findings / 2 usage error — safe to wire
 directly into a pre-commit hook or CI step. A per-rule finding summary
 is printed to stderr after the report (same aligned-table helper the
-obs_dump metrics view uses).
+obs_dump metrics view uses). The whole main loop is tools/_common.py's
+`lint_main` — graphlint.py is the same shell over the graph auditor.
 """
 from __future__ import annotations
 
@@ -21,28 +22,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from _common import kv_table, make_parser
+from _common import lint_main
 
 from arbius_tpu.analysis.cli import build_arg_parser, collect, render
 
 
 def main(argv=None) -> int:
-    parser = build_arg_parser(make_parser("detlint", __doc__))
-    try:
-        ns = parser.parse_args(argv)
-    except SystemExit as e:
-        return int(e.code or 0)
-    rc, findings = collect(ns)
-    if rc is not None:
-        return rc
-    render(ns, findings, sys.stdout)
-    if findings and not ns.json:
-        # quick triage view: which rules are firing, how often
-        counts: dict[str, int] = {}
-        for f in findings:
-            counts[f.rule] = counts.get(f.rule, 0) + 1
-        print("\nfindings by rule:\n" + kv_table(counts), file=sys.stderr)
-    return 1 if findings else 0
+    return lint_main("detlint", __doc__, build_arg_parser, collect, render,
+                     argv)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""graphlint — jaxpr/XLA-program audit with golden fingerprints.
+
+Pre-commit / CI front door for `arbius_tpu.analysis.graph` (rule
+catalog and fingerprint model in docs/graph-audit.md): traces every
+registered pipeline's jittable entry points to jaxprs (abstract shapes,
+abstract meshes — CPU-only, seconds), runs the GRAPH4xx rules, and
+checks canonical program fingerprints against goldens/graph/.
+
+    python tools/graphlint.py                     # audit everything
+    python tools/graphlint.py --json              # stable JSON report
+    python tools/graphlint.py --list              # registered spec keys
+    python tools/graphlint.py --spec anythingv3   # one model's specs
+    python tools/graphlint.py --golden-update     # regenerate goldens
+
+Exit codes: 0 clean / 1 findings (rule hit or fingerprint drift) /
+2 usage error — identical contract to detlint.py; both are shells over
+tools/_common.py's `lint_main`. Regenerating goldens is a reviewed
+operation: goldens/graph/README.md says when it is legitimate.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import lint_main
+
+from arbius_tpu.analysis.graph.cli import build_arg_parser, collect, render
+
+
+def main(argv=None) -> int:
+    return lint_main("graphlint", __doc__, build_arg_parser, collect,
+                     render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
