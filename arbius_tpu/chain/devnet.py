@@ -408,7 +408,12 @@ class DevnetNode:
             "address": self.engine_address,
             "topics": topics,
             "data": "0x" + abi_encode(data_types, data_values).hex(),
-            "blockNumber": hex(self.engine.block_number),
+            # the tx lands in the block BEING mined (block_number + 1
+            # after the automine), not the already-reported latest one: a
+            # poller that saw latest=N must find this log at N+1, or any
+            # event racing a poll of the same number is lost forever
+            # (found by simnet's clean scenario)
+            "blockNumber": hex(self.engine.block_number + 1),
             "transactionHash": self._current_txhash or "0x" + "00" * 32,
             "logIndex": hex(len(self.logs)),
         })
@@ -535,7 +540,9 @@ class DevnetNode:
             "hash": txhash, "from": dec.sender,
             "to": dec.tx.to, "nonce": hex(dec.tx.nonce),
             "input": "0x" + dec.tx.data.hex(),
-            "blockNumber": hex(self.engine.block_number),
+            # same block-numbering rule as the logs: the tx lands in the
+            # block the automine below seals
+            "blockNumber": hex(self.engine.block_number + 1),
         }
         self.engine.mine_block()
         return txhash

@@ -47,15 +47,67 @@ def _point_add(p1, p2):
     return x3, (lam * (x1 - x3) - y1) % P
 
 
+# Scalar multiplication runs in Jacobian coordinates (x = X/Z², y =
+# Y/Z³): the affine ladder above pays one modular inversion PER BIT
+# (~256 `pow(a, -1, P)` per multiply — it dominated the simnet profile,
+# where every chain write is a signed tx), Jacobian pays ONE at the end.
+# `_point_add` stays as the affine reference; tests pin both paths equal.
+
+def _jac_double(X1: int, Y1: int, Z1: int):
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = B * B % P
+    D = 2 * ((X1 + B) * (X1 + B) - A - C) % P
+    E = 3 * A % P
+    X3 = (E * E - 2 * D) % P
+    Y3 = (E * (D - X3) - 8 * C) % P
+    Z3 = 2 * Y1 * Z1 % P
+    return X3, Y3, Z3
+
+
+def _jac_add(p1, p2):
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    if Z1 == 0:
+        return p2
+    if Z2 == 0:
+        return p1
+    Z1Z1 = Z1 * Z1 % P
+    Z2Z2 = Z2 * Z2 % P
+    U1 = X1 * Z2Z2 % P
+    U2 = X2 * Z1Z1 % P
+    S1 = Y1 * Z2 * Z2Z2 % P
+    S2 = Y2 * Z1 * Z1Z1 % P
+    if U1 == U2:
+        if S1 != S2:
+            return (0, 1, 0)        # P + (−P) = infinity
+        return _jac_double(X1, Y1, Z1)
+    H = (U2 - U1) % P
+    I = 4 * H * H % P
+    J = H * I % P
+    r = 2 * (S2 - S1) % P
+    V = U1 * I % P
+    X3 = (r * r - J - 2 * V) % P
+    Y3 = (r * (V - X3) - 2 * S1 * J) % P
+    Z3 = ((Z1 + Z2) * (Z1 + Z2) - Z1Z1 - Z2Z2) * H % P
+    return X3, Y3, Z3
+
+
 def _point_mul(k: int, point=(GX, GY)):
-    result = None
-    addend = point
+    if point is None:
+        return None
+    acc = (0, 1, 0)                 # infinity
+    add = (point[0], point[1], 1)
     while k:
         if k & 1:
-            result = _point_add(result, addend)
-        addend = _point_add(addend, addend)
+            acc = _jac_add(acc, add)
+        add = _jac_double(*add)
         k >>= 1
-    return result
+    if acc[2] == 0:
+        return None
+    zi = _inv(acc[2], P)
+    zi2 = zi * zi % P
+    return acc[0] * zi2 % P, acc[1] * zi2 % P * zi % P
 
 
 @dataclass(frozen=True)

@@ -201,7 +201,15 @@ def _samples(data: bytes) -> list[bytes]:
 def decode_h264_mp4_yuv(data: bytes
                         ) -> list[tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """avc1 MP4 → per-frame (Y, Cb, Cr) uint8 planes, cropped to the
-    SPS-declared geometry."""
+    SPS-declared geometry.
+
+    Supported input is the repo's own artifact class ONLY: all-IDR
+    I_PCM streams (every frame a type-5 IDR slice, as codecs/h264.py
+    emits). Inter-predicted input (VCL NAL types 1-4: non-IDR /
+    partitioned slices, what a general encoder produces) is REJECTED
+    rather than skipped — silently dropping those frames used to matte
+    a truncated clip from an external avc1 file, which looks like a
+    model bug instead of an input-format error."""
     sps, pps = _avc_config(data)
     out = []
     for sample in _samples(data):
@@ -216,6 +224,12 @@ def decode_h264_mp4_yuv(data: bytes
                 h, wd = sps["height"], sps["width"]
                 out.append((y[:h, :wd], cb[:h // 2, :wd // 2],
                             cr[:h // 2, :wd // 2]))
+            elif nal_type in (1, 2, 3, 4):
+                raise ValueError(
+                    f"inter-predicted H.264 input (VCL NAL type {nal_type}"
+                    f" at frame {len(out)}): only all-IDR I_PCM avc1 "
+                    "streams are supported — re-encode the clip intra-only "
+                    "(e.g. the codecs/h264.py encoder) before submitting")
     return out
 
 

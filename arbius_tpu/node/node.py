@@ -152,6 +152,7 @@ class MinerNode:
             raise BootError(
                 f"chain version {self.chain.version()} > miner {MINER_VERSION}"
                 " — update the node (index.ts:960-969)")
+        self._check_attention_impl(skip_self_test=skip_self_test)
         if not skip_self_test:
             self._boot_self_test()
         delegated = getattr(self.chain, "validator_address", self.chain.address)
@@ -174,6 +175,32 @@ class MinerNode:
         self.chain.subscribe(self._on_event)
         log.info("node booted: %d models, address %s",
                  len(self.registry.ids()), self.chain.address)
+
+    def _check_attention_impl(self, *, skip_self_test: bool) -> None:
+        """A non-default attention impl is a different reduction order —
+        a different determinism class — so it may only mine if the boot
+        self-test proves it still reproduces the recorded goldens
+        (ops/flash.py pins the impl once at import; runtime toggles are
+        impossible by construction)."""
+        from arbius_tpu.ops.flash import attention_impl
+
+        impl = attention_impl()
+        if impl == "auto":
+            return
+        has_golden = any(self.registry.get(mid).golden is not None
+                         for mid in self.registry.ids())
+        if not has_golden:
+            log.warning(
+                "ARBIUS_ATTN_IMPL=%s with no golden vectors registered — "
+                "nothing proves this impl matches the fleet's determinism "
+                "class; record goldens before mining for real", impl)
+            return
+        if skip_self_test:
+            raise BootError(
+                f"ARBIUS_ATTN_IMPL={impl}: a non-default attention impl "
+                "must pass the boot self-test against the recorded goldens "
+                "(its reduction order defines the determinism class) — do "
+                "not skip the self-test, or unset the override")
 
     def _boot_self_test(self) -> None:
         """Golden-CID reproducibility check before mining anything
@@ -580,28 +607,48 @@ class MinerNode:
                 expretry(lambda: self.chain.submit_solution(taskid, cid),
                          tries=3, max_delay=self.config.retry_max_delay,
                          sleep=self._retry_sleep, op="submit_solution")
-            self._inc("solutions_submitted")
-            self._h_latency.observe(self.chain.now - t_start, tag=taskid)
-            self.db.queue_job(
-                "claim", {"taskid": taskid},
-                waituntil=self.chain.now
-                + self.chain.min_claim_solution_time()
-                + self.config.claim_delay_buffer)
         except RetriesExhausted:
             sol = self.chain.get_solution(taskid)
-            if sol is not None and "0x" + sol.cid.hex() != cid:
+            if sol is None:
+                # the reveal never landed at all — re-raise so the job
+                # quarantines visibly instead of silently dropping the
+                # task (simnet SIM101 task-conservation: every task must
+                # reach an accounted terminal state)
+                raise
+            if "0x" + sol.cid.hex() != cid:
                 # lost the race to a wrong answer → contest
                 self.db.mark_invalid_task(taskid)
                 self.db.queue_job("contest", {"taskid": taskid}, priority=50)
+                return
+            if sol.validator != self.chain.address:
+                return  # honest race lost: same bytes, their reward
+            # our reveal LANDED but the response was lost (the retries
+            # saw "solution already submitted" for our own solution) —
+            # fall through to the success bookkeeping, or the claim
+            # would never be scheduled (found by simnet rpc-flap)
+        self._inc("solutions_submitted")
+        self._h_latency.observe(self.chain.now - t_start, tag=taskid)
+        self.db.queue_job(
+            "claim", {"taskid": taskid},
+            waituntil=self.chain.now
+            + self.chain.min_claim_solution_time()
+            + self.config.claim_delay_buffer)
 
     def _process_claim(self, data: dict) -> None:
         """index.ts:728-750."""
         taskid = data["taskid"]
         if self.chain.get_contestation(taskid) is not None:
             return  # resolved via contestationVoteFinish instead
-        expretry(lambda: self.chain.claim_solution(taskid),
-                 tries=3, max_delay=self.config.retry_max_delay,
-                 sleep=self._retry_sleep, op="claim_solution")
+        try:
+            expretry(lambda: self.chain.claim_solution(taskid),
+                     tries=3, max_delay=self.config.retry_max_delay,
+                     sleep=self._retry_sleep, op="claim_solution")
+        except RetriesExhausted:
+            sol = self.chain.get_solution(taskid)
+            if sol is None or not sol.claimed:
+                raise  # genuinely unclaimed — quarantine visibly
+            # the claim LANDED but the response was lost (the retries saw
+            # "already claimed") — count it (found by simnet rpc-flap)
         self._inc("solutions_claimed")
 
     def _process_contest(self, data: dict) -> None:
@@ -668,22 +715,27 @@ class MinerNode:
 
     def _process_validator_stake(self, data: dict) -> None:
         """Auto top-up (index.ts:397-472) with the 1%/20% buffers, then
-        re-queue self at +interval."""
-        minimum = self.chain.get_validator_minimum()
-        staked = self.chain.validator_staked() - \
-            self.chain.validator_withdraw_pending()
-        floor = minimum + int(minimum * self.config.stake.buffer_min_percent)
-        if staked < floor:
-            target = minimum + int(minimum * self.config.stake.buffer_percent)
-            need = target - staked
-            if need > 0:
-                if self.chain.token_balance() < need:
-                    log.error("stake top-up needs %d but balance is %d",
-                              need, self.chain.token_balance())
-                else:
-                    self.chain.validator_deposit(need)
-        self.db.queue_job("validatorStake", {}, priority=100,
-                          waituntil=self.chain.now + self.config.stake.check_interval)
+        re-queue self at +interval — in a finally: a transient RPC fault
+        must not kill the heartbeat forever (a quarantined stake job
+        would never re-queue itself; found by simnet rpc-flap)."""
+        try:
+            minimum = self.chain.get_validator_minimum()
+            staked = self.chain.validator_staked() - \
+                self.chain.validator_withdraw_pending()
+            floor = minimum + int(minimum * self.config.stake.buffer_min_percent)
+            if staked < floor:
+                target = minimum + int(minimum * self.config.stake.buffer_percent)
+                need = target - staked
+                if need > 0:
+                    if self.chain.token_balance() < need:
+                        log.error("stake top-up needs %d but balance is %d",
+                                  need, self.chain.token_balance())
+                    else:
+                        self.chain.validator_deposit(need)
+        finally:
+            self.db.queue_job("validatorStake", {}, priority=100,
+                              waituntil=self.chain.now
+                              + self.config.stake.check_interval)
 
     def _process_automine(self, data: dict) -> None:
         """Self-submitted work (index.ts:474-503)."""

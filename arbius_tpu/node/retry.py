@@ -33,6 +33,12 @@ from arbius_tpu.obs import current_obs
 T = TypeVar("T")
 
 
+# the reference's backoff base (utils.ts:21-39). Exported because the
+# simnet SIM105 checker re-derives the exact expected curve from it —
+# tuning the policy here must move the checker with it.
+BASE = 1.5
+
+
 class RetriesExhausted(Exception):
     def __init__(self, attempts: int, last: Exception):
         super().__init__(f"failed after {attempts} attempts: {last!r}")
@@ -40,7 +46,7 @@ class RetriesExhausted(Exception):
         self.last = last
 
 
-def expretry(fn: Callable[[], T], *, tries: int = 10, base: float = 1.5,
+def expretry(fn: Callable[[], T], *, tries: int = 10, base: float = BASE,
              max_delay: float | None = None,
              sleep: Callable[[float], None] = time.sleep,
              op: str = "") -> T:

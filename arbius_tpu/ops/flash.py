@@ -109,6 +109,52 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return out[:, :sq, :d].reshape(b, h, sq, d)
 
 
+VALID_ATTN_IMPLS = ("auto", "flash", "flash_nopad", "einsum")
+
+
+def _read_attn_impl() -> str:
+    import os
+
+    impl = os.environ.get("ARBIUS_ATTN_IMPL", "auto")
+    if impl not in VALID_ATTN_IMPLS:
+        # a typo must not silently measure/run a different impl than the
+        # label claims — the A/B exists to decide the production dispatch
+        raise ValueError(f"ARBIUS_ATTN_IMPL={impl!r} not in "
+                         + "|".join(VALID_ATTN_IMPLS))
+    return impl
+
+
+# Pinned ONCE at import. Reading the env var at trace time looked like a
+# runtime toggle but wasn't one: jitted callers only re-read it on a
+# retrace, so flipping it after a shape bucket compiled silently kept
+# the old impl — and a flip that DID land would change reduction order,
+# i.e. the golden CIDs' determinism class. The node boots against this
+# pinned value (MinerNode._check_attention_impl) and the profiler
+# threads its A/B through set_attention_impl(), re-jitting per impl.
+_ATTN_IMPL = _read_attn_impl()
+
+
+def attention_impl() -> str:
+    """The attention dispatch pinned for this process."""
+    return _ATTN_IMPL
+
+
+def set_attention_impl(impl: str | None) -> str:
+    """Explicitly re-pin the dispatch (A/B measurement only — callers
+    own the retrace; tools/tpu_profile.py builds a fresh jit per impl).
+    `None` restores the env-pinned import-time value. Returns the
+    previous value so callers can restore it."""
+    global _ATTN_IMPL
+
+    if impl is None:
+        impl = _read_attn_impl()
+    if impl not in VALID_ATTN_IMPLS:
+        raise ValueError(f"attention impl {impl!r} not in "
+                         + "|".join(VALID_ATTN_IMPLS))
+    prior, _ATTN_IMPL = _ATTN_IMPL, impl
+    return prior
+
+
 def attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     """Backend-dispatching exact attention for [B, H, S, D].
 
@@ -116,23 +162,19 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     einsum path (which XLA already fuses well at short S, and which is
     the only compiled option off-TPU).
 
-    ARBIUS_ATTN_IMPL overrides the dispatch for on-chip A/B measurement
-    (tools/tpu_profile.py drives the FULL UNet step under each value):
-    "flash" | "flash_nopad" | "einsum" | "auto" (default). All three are
-    exact attention; they differ in reduction order (ULP-class output
-    drift), so a fleet pins ONE impl per determinism class — changing
-    the production dispatch re-records the platform goldens.
+    The module-level pinned impl (ARBIUS_ATTN_IMPL at import, or an
+    explicit set_attention_impl) overrides the dispatch for on-chip A/B
+    measurement (tools/tpu_profile.py drives the FULL UNet step under
+    each value): "flash" | "flash_nopad" | "einsum" | "auto" (default).
+    All three are exact attention; they differ in reduction order
+    (ULP-class output drift), so a fleet pins ONE impl per determinism
+    class — changing the production dispatch re-records the platform
+    goldens, and a node booting with a non-default impl must prove its
+    goldens still hold (node.py boot check).
     """
-    import os
-
     from arbius_tpu.ops.ring import sp_attention_reference
 
-    impl = os.environ.get("ARBIUS_ATTN_IMPL", "auto")
-    if impl not in ("auto", "flash", "flash_nopad", "einsum"):
-        # a typo must not silently measure/run a different impl than the
-        # label claims — the A/B exists to decide the production dispatch
-        raise ValueError(f"ARBIUS_ATTN_IMPL={impl!r} not in "
-                         "auto|flash|flash_nopad|einsum")
+    impl = _ATTN_IMPL
     if impl == "einsum":
         return sp_attention_reference(q, k, v)
     on_tpu = jax.default_backend() == "tpu"

@@ -1,0 +1,6 @@
+# detlint: enforce[DET101,DET102,DET103,DET105]
+import sys
+
+from arbius_tpu.sim.cli import main
+
+sys.exit(main())

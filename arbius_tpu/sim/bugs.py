@@ -1,0 +1,43 @@
+"""Deliberately broken nodes — regression ammunition for the checkers.
+
+A checker that has never caught anything is a checker you can't trust.
+Each class here injects one protocol violation into an otherwise-real
+`MinerNode`; the tier-1 regression (tests/test_sim.py) and the CLI's
+`--inject-bug` flag run a scenario with the buggy node and require the
+matching SIM1xx finding to fire with a readable diff. These nodes must
+NEVER be reachable from production wiring — only the sim harness's
+`node_cls` seam constructs them.
+"""
+# detlint: enforce[DET101,DET102,DET103,DET105]
+from __future__ import annotations
+
+from arbius_tpu.chain.devnet import DevnetError
+from arbius_tpu.node import MinerNode
+from arbius_tpu.node.chain_client import EngineError
+
+
+class DoubleCommitMinerNode(MinerNode):
+    """Signals a SECOND commitment — for a corrupted CID — next to every
+    real one: the double-commit a slashing-grade bug would produce.
+    The chain happily accepts both (they are different hashes), so only
+    the SIM103 checker can see the violation."""
+
+    @staticmethod
+    def _corrupt(cid: str) -> str:
+        flipped = format(int(cid[-1], 16) ^ 0x1, "x")
+        return cid[:-1] + flipped
+
+    def _commit_reveal(self, taskid: str, cid: str, t_start: int) -> None:
+        if self.chain.get_solution(taskid) is None:
+            wrong = self._corrupt(cid)
+            second = self.chain.generate_commitment(taskid, wrong)
+            try:
+                self.chain.signal_commitment(second)
+            except (EngineError, DevnetError):  # pragma: no cover
+                pass
+        super()._commit_reveal(taskid, cid, t_start)
+
+
+INJECTABLE_BUGS = {
+    "double-commit": DoubleCommitMinerNode,
+}

@@ -1,0 +1,162 @@
+"""simnet command line — `python -m arbius_tpu.sim` / tools/simsoak.py.
+
+Same contract as detlint/graphlint (arbius_tpu.analysis.cli defines it
+once): exit 0 = every scenario run passed every invariant checker,
+1 = findings, 2 = usage error. Any failing run prints the exact
+`--scenario`/`--seed` pair that reproduces it byte-identically.
+
+    python -m arbius_tpu.sim                         # clean, seed 0
+    python -m arbius_tpu.sim --scenario rpc-flap --seed 7
+    python -m arbius_tpu.sim --scenario all --seeds 3 --json
+    python -m arbius_tpu.sim --list                  # scenario catalog
+    python -m arbius_tpu.sim --inject-bug double-commit   # must exit 1
+"""
+# detlint: enforce[DET101,DET102,DET103,DET105]
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from arbius_tpu.analysis.cli import EXIT_CLEAN, EXIT_FINDINGS, EXIT_USAGE
+
+
+def build_arg_parser(p: argparse.ArgumentParser | None = None
+                     ) -> argparse.ArgumentParser:
+    if p is None:
+        p = argparse.ArgumentParser(
+            prog="simsoak", description=__doc__,
+            formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--scenario", default="clean",
+                   help="scenario name, 'all' for the full catalog, or "
+                        "'tier1' for the acceptance matrix (default: clean)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="base scenario seed (default: 0)")
+    p.add_argument("--seeds", type=int, default=1,
+                   help="soak mode: run seeds seed..seed+N-1 per scenario "
+                        "(default: 1)")
+    p.add_argument("--tasks", type=int, default=None,
+                   help="override the scenario's task count")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (stable key order; "
+                        "byte-identical for identical scenario+seed)")
+    p.add_argument("--list", action="store_true",
+                   help="list the scenario catalog and exit")
+    p.add_argument("--inject-bug", default=None,
+                   help="run with a deliberately broken node (checker "
+                        "regression); known: double-commit")
+    p.add_argument("--workdir", default=None,
+                   help="directory for node sqlite checkpoints (default: "
+                        "a temporary directory; crash-restart scenarios "
+                        "need durable files either way)")
+    return p
+
+
+def _resolve_scenarios(name: str):
+    from arbius_tpu.sim.scenario import SCENARIOS, TIER1_MATRIX, get_scenario
+
+    if name == "all":
+        return [SCENARIOS[k] for k in sorted(SCENARIOS)]
+    if name == "tier1":
+        return [SCENARIOS[k] for k in TIER1_MATRIX]
+    return [get_scenario(name)]
+
+
+def collect(ns: argparse.Namespace):
+    """Run the requested (scenario × seed) grid; findings are the
+    invariant violations across every run. Returns (exit_code, findings)
+    with lint_main's short-circuit convention; run summaries ride on
+    `ns` for render()."""
+    import os
+    import tempfile
+
+    from arbius_tpu.node import MinerNode
+    from arbius_tpu.sim.bugs import INJECTABLE_BUGS
+    from arbius_tpu.sim.harness import run_scenario
+    from arbius_tpu.sim.invariants import check_all, summarize
+    from arbius_tpu.sim.scenario import SCENARIOS
+
+    ns._runs = []
+    if ns.list:
+        for name in sorted(SCENARIOS):
+            s = SCENARIOS[name]
+            print(f"{name:15s} tasks={s.tasks:<3d} {s.description}")
+        return EXIT_CLEAN, []
+    node_cls = MinerNode
+    if ns.inject_bug is not None:
+        node_cls = INJECTABLE_BUGS.get(ns.inject_bug)
+        if node_cls is None:
+            print(f"simsoak: unknown --inject-bug {ns.inject_bug!r} "
+                  f"(known: {', '.join(sorted(INJECTABLE_BUGS))})",
+                  file=sys.stderr)
+            return EXIT_USAGE, []
+    try:
+        scenarios = _resolve_scenarios(ns.scenario)
+    except KeyError as e:
+        print(f"simsoak: {e.args[0]}", file=sys.stderr)
+        return EXIT_USAGE, []
+    if ns.seeds < 1:
+        print("simsoak: --seeds must be >= 1", file=sys.stderr)
+        return EXIT_USAGE, []
+
+    findings = []
+    with tempfile.TemporaryDirectory(prefix="simnet-") as tmp:
+        workdir = ns.workdir or tmp
+        for scenario in scenarios:
+            scenario = scenario.with_tasks(ns.tasks)
+            for seed in range(ns.seed, ns.seed + ns.seeds):
+                db_path = os.path.join(
+                    workdir, f"{scenario.name}-{seed}.sqlite")
+                result = run_scenario(scenario, seed, db_path=db_path,
+                                      node_cls=node_cls)
+                run_findings = check_all(result)
+                findings.extend(run_findings)
+                summary = summarize(result)
+                summary["findings"] = len(run_findings)
+                ns._runs.append(summary)
+                if run_findings:
+                    print(f"simsoak: {len(run_findings)} invariant "
+                          f"violation(s) — reproduce with: {result.repro()}",
+                          file=sys.stderr)
+    return None, findings
+
+
+def render(ns: argparse.Namespace, findings, out) -> None:
+    runs = getattr(ns, "_runs", [])
+    if ns.json:
+        doc = {"version": 1,
+               "findings": [f.to_json() for f in findings],
+               "runs": runs}
+        out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+        return
+    for r in runs:
+        terminal = " ".join(f"{k}={v}" for k, v in r["terminal"].items())
+        faults = sum(r["faults_injected"].values())
+        out.write(
+            f"{r['scenario']:15s} seed={r['seed']:<4d} "
+            f"tasks={r['tasks']:<3d} rounds={r['rounds']:<4d} "
+            f"faults={faults:<4d} restarts={r['restarts']} "
+            f"[{terminal}]\n")
+    for f in findings:
+        out.write(f.text() + "\n")
+    if findings:
+        out.write(f"simsoak: {len(findings)} invariant violation(s)\n")
+
+
+def run(ns: argparse.Namespace, out=None) -> int:
+    out = out or sys.stdout
+    rc, findings = collect(ns)
+    if rc is not None:
+        return rc
+    render(ns, findings, out)
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
+
+
+def main(argv: list[str] | None = None) -> int:
+    from arbius_tpu.analysis.cli import cli_entry
+
+    return cli_entry(build_arg_parser, collect, render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

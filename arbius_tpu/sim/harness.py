@@ -1,0 +1,351 @@
+"""SimHarness — one scenario run: world, workload, crash-restart, drain.
+
+The system under test is the REAL production stack end to end: a
+`MinerNode` whose chain facade is `RpcChain` over signed EIP-1559
+transactions into the in-process `DevnetNode`, with the fault plane's
+`FaultTransport` as the only wire between them. The workload submitter
+(user wallet) rides a clean transport — the user is not under test —
+while an adversarial validator and a juror act directly on the engine
+(their behavior is scripted, not simulated).
+
+Run shape:
+
+  setup   genesis mint/approve/stake, emit 100k wad from the engine so
+          the validator-minimum and slashing thresholds actually bite,
+          register the model, boot the node (plane disarmed — a dead
+          endpoint at boot is a boot failure, not a scenario)
+  rounds  one task submitted per round until the workload is exhausted
+          (some flagged invalid-input or front-run by the adversary,
+          per seeded draws), node.tick(), juror votes on open
+          contestations, stakes sampled, virtual clock advanced
+  drain   keep ticking; when nothing is due, jump the clock to the
+          earliest pending job (claim windows, vote-finish windows);
+          quiescent when only heartbeat jobs and no in-flight fault-
+          plane events remain
+  crash   a `SimCrash` out of tick() tears the node down (db connection
+          closed, obs journal snapshotted) and a fresh node boots from
+          the same sqlite file — re-polling the chain from block 0 and
+          recovering its queue from the checkpoint
+
+The result bundle (`SimResult`) is everything the invariant checkers
+audit; `run_scenario()` is the one-call front door the CLI and tests
+share.
+"""
+# detlint: enforce[DET101,DET102,DET103,DET105]
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from arbius_tpu.chain.devnet import DevnetError, DevnetNode
+from arbius_tpu.chain.engine import Engine
+from arbius_tpu.chain.fixedpoint import WAD
+from arbius_tpu.chain.rpc_client import EngineRpcClient, RpcError
+from arbius_tpu.chain.token import TokenLedger
+from arbius_tpu.chain.wallet import Wallet
+from arbius_tpu.node import (
+    LocalChain,
+    MinerNode,
+    MiningConfig,
+    ModelConfig,
+    ModelRegistry,
+    NodeDB,
+    RegisteredModel,
+)
+from arbius_tpu.node.solver import EVIL_CID
+from arbius_tpu.obs import use_obs
+from arbius_tpu.sim.clock import VirtualClock
+from arbius_tpu.sim.faults import (
+    AuditedRpcChain,
+    FaultPlane,
+    FaultTransport,
+    FaultyRunner,
+    SimCrash,
+    SimPinner,
+)
+from arbius_tpu.sim.scenario import Scenario
+from arbius_tpu.templates.engine import load_template
+
+CHAIN_ID = 31337
+KEY_MINER = "0x" + "a1" * 32
+KEY_USER = "0x" + "b2" * 32
+EVIL = "0x" + "ee" * 20
+JUROR = "0x" + "dc" * 20
+START_TIME = 100_000
+EMITTED_WAD = 100_000        # pseudo-supply so minimum/slash are nonzero
+_HEARTBEATS = ("automine", "validatorStake")
+
+
+class _CleanTransport:
+    """Faultless DevnetNode transport for actors not under test."""
+
+    def __init__(self, dev: DevnetNode):
+        self.dev = dev
+
+    def request(self, method: str, params: list):
+        try:
+            return self.dev.request(method, params)
+        except DevnetError as e:
+            raise RpcError(str(e)) from None
+
+
+@dataclass
+class TaskFlags:
+    index: int
+    invalid: bool = False
+    evil: bool = False
+
+
+@dataclass
+class SimResult:
+    """Everything a checker can audit, plus the run's summary numbers."""
+    scenario: Scenario
+    seed: int
+    plane: FaultPlane
+    engine: Engine
+    db: NodeDB
+    tasks: dict[str, TaskFlags] = field(default_factory=dict)
+    journal_events: list[dict] = field(default_factory=list)
+    min_stake_seen: int = 0
+    quiescent: bool = True
+    rounds: int = 0
+    restarts: int = 0
+    retry_max_delay: float = 30.0
+    miner_address: str = ""
+
+    def repro(self) -> str:
+        return (f"python -m arbius_tpu.sim --scenario "
+                f"{self.scenario.name} --seed {self.seed} "
+                f"--tasks {self.scenario.tasks}")
+
+
+class SimHarness:
+    def __init__(self, scenario: Scenario, seed: int,
+                 db_path: str = ":memory:",
+                 node_cls: type[MinerNode] = MinerNode):
+        if scenario.faults.crash_after_commit is not None \
+                and db_path == ":memory:":
+            # a restart from :memory: builds an EMPTY NodeDB — the run
+            # would "test" recovery from a checkpoint that never existed
+            # and report violations whose repro line (which always uses a
+            # real file) passes
+            raise ValueError(
+                f"scenario {scenario.name!r} crash-restarts the node: "
+                "pass a real sqlite db_path so the reboot actually "
+                "recovers from the checkpoint")
+        self.scenario = scenario
+        self.seed = seed
+        self.db_path = db_path
+        self.node_cls = node_cls
+
+        self.token = TokenLedger()
+        self.engine = Engine(self.token, start_time=START_TIME)
+        self.token.mint(Engine.ADDRESS, 600_000 * WAD)
+        self.dev = DevnetNode(self.engine, chain_id=CHAIN_ID)
+        self.clock = VirtualClock(self.engine)
+
+        self.miner_wallet = Wallet.from_hex(KEY_MINER)
+        self.user_wallet = Wallet.from_hex(KEY_USER)
+        self.plane = FaultPlane(scenario, seed, self.clock, self.engine,
+                                self.miner_wallet.address)
+        self._rng_work = self.plane._rng_rpc.stream("workload")
+
+        # genesis: emitted supply + funded actors + adversary/juror stakes
+        self.token.transfer(Engine.ADDRESS, "0x" + "99" * 20,
+                            EMITTED_WAD * WAD)
+        for addr in (self.miner_wallet.address, self.user_wallet.address,
+                     EVIL, JUROR):
+            self.token.mint(addr, 1_000 * WAD)
+            self.token.approve(addr.lower(), Engine.ADDRESS, 10**30)
+        self.evil_chain = LocalChain(self.engine, EVIL)
+        self.juror_chain = LocalChain(self.engine, JUROR)
+        self.evil_chain.validator_deposit(200 * WAD)
+        self.juror_chain.validator_deposit(200 * WAD)
+        # pre-stake the miner well above the minimum: per-contest slash
+        # escrows subtract from usable stake mid-run, and a node wedged
+        # below the minimum between stake-heartbeat runs would turn every
+        # scenario into a stake test
+        self.engine.validator_deposit(self.miner_wallet.address,
+                                      self.miner_wallet.address, 400 * WAD)
+        # age the stakes past the anti-vote-buying gate (EngineV1.sol:976)
+        self.engine.advance_time(
+            self.engine.max_contestation_validator_stake_since + 100,
+            blocks=0)
+
+        mid_b = self.engine.register_model(
+            self.user_wallet.address, self.user_wallet.address, 0,
+            b'{"meta":{"title":"simnet"}}')
+        self.model_id = "0x" + mid_b.hex()
+        self.user_client = EngineRpcClient(
+            _CleanTransport(self.dev), self.dev.engine_address,
+            self.user_wallet, chain_id=CHAIN_ID)
+
+        self._submitted_ids: list[str] = []
+        self.engine.subscribe(self._record_task_event)
+
+        self.result = SimResult(scenario=scenario, seed=seed,
+                                plane=self.plane, engine=self.engine,
+                                db=None, miner_address=self.miner_wallet
+                                .address.lower())
+        self.node: MinerNode | None = None
+        self._spawn_node()
+
+    # -- world ------------------------------------------------------------
+    def _record_task_event(self, ev) -> None:
+        if ev.name == "TaskSubmitted":
+            self._submitted_ids.append("0x" + ev.args["id"].hex())
+
+    def _spawn_node(self) -> None:
+        transport = FaultTransport(self.dev, self.plane)
+        client = EngineRpcClient(transport, self.dev.engine_address,
+                                 self.miner_wallet, chain_id=CHAIN_ID)
+        chain = AuditedRpcChain(client, self.dev.token_address, self.plane)
+        cfg = MiningConfig(
+            db_path=":memory:",  # unused: db object injected below
+            models=(ModelConfig(id=self.model_id, template="anythingv3"),),
+            compile_cache_dir=None,
+            obs_journal_capacity=16384,
+            retry_max_delay=self.result.retry_max_delay)
+        registry = ModelRegistry()
+        registry.register(RegisteredModel(
+            id=self.model_id, template=load_template("anythingv3"),
+            runner=FaultyRunner(self.plane)))
+        db = NodeDB(self.db_path)
+        node = self.node_cls(chain, cfg, registry, db=db, store=None,
+                             pinner=SimPinner(self.plane))
+        node._retry_sleep = self.clock.sleep
+        node.boot(skip_self_test=True)
+        self.node = node
+        self.result.db = db
+
+    def _restart_node(self) -> None:
+        """Crash recovery: snapshot the dead node's flight recorder,
+        close its db handle, boot a replacement from the same sqlite
+        checkpoint (fresh RpcChain — it re-polls from block 0 and the
+        db's INSERT OR IGNORE absorbs the replayed history)."""
+        self.result.journal_events.extend(self.node.obs.journal.events())
+        self.result.restarts += 1
+        self.node.db.close()
+        armed = self.plane.armed
+        self.plane.armed = False     # boot is not under fault injection
+        try:
+            self._spawn_node()
+        finally:
+            self.plane.armed = armed
+
+    # -- workload ----------------------------------------------------------
+    def _task_input(self, i: int, invalid: bool) -> bytes:
+        import json
+
+        if invalid:
+            # undecodable JSON: hydration must fail and the node must
+            # remember the task as invalid (contestation evidence)
+            return b'{"prompt": broken'
+        return json.dumps({"prompt": f"simnet task {i} "
+                                     f"{self._rng_work.u64():x}",
+                           "negative_prompt": ""},
+                          sort_keys=True).encode()
+
+    def _submit_task(self, i: int) -> None:
+        invalid = self._rng_work.chance(self.scenario.invalid_rate)
+        evil = (not invalid) and self._rng_work.chance(self.scenario.evil_rate)
+        fee = self.scenario.fee_wad * WAD
+        self.user_client.send("submitTask", [
+            0, self.user_wallet.address, self.model_id, fee,
+            self._task_input(i, invalid)])
+        tid = self._submitted_ids[-1]
+        self.result.tasks[tid] = TaskFlags(index=i, invalid=invalid,
+                                           evil=evil)
+        if evil:
+            # adversary front-runs with a deliberately wrong CID before
+            # the node can even see the task (commit tx mines a block, so
+            # the reveal is immediately valid)
+            c = self.evil_chain.generate_commitment(tid, EVIL_CID)
+            self.evil_chain.signal_commitment(c)
+            self.evil_chain.submit_solution(tid, EVIL_CID)
+
+    def _juror_pass(self) -> None:
+        """Scripted third validator: votes yea on every open contestation
+        (the node's yea + juror's yea out-vote the accused's auto-nay, so
+        a contested wrong answer actually loses)."""
+        for tid, flags in self.result.tasks.items():
+            if not flags.evil:
+                continue
+            tb = bytes.fromhex(tid[2:])
+            if tb not in self.engine.contestations:
+                continue
+            if self.juror_chain.contestation_voted(tid):
+                continue
+            if self.juror_chain.validator_can_vote(tid) != 0:
+                continue
+            self.juror_chain.vote_on_contestation(tid, True)
+
+    # -- driving -----------------------------------------------------------
+    def _tick(self) -> int:
+        try:
+            return self.node.tick()
+        except SimCrash:
+            self._restart_node()
+            return 0
+
+    def _sample_stakes(self) -> None:
+        for v in self.engine.validators.values():
+            if v.staked < self.result.min_stake_seen:
+                self.result.min_stake_seen = v.staked
+
+    def _pending_jobs(self) -> list:
+        jobs = self.node.db.get_jobs(2**60, limit=1000)
+        return [j for j in jobs if j.method not in _HEARTBEATS]
+
+    def run(self) -> SimResult:
+        scenario, result = self.scenario, self.result
+        with use_obs(self.node.obs):
+            self._tick()             # settle the boot-queued stake job
+        self.plane.armed = True
+        submitted = 0
+        rounds = 0
+        while rounds < scenario.max_rounds:
+            rounds += 1
+            # a restart swaps self.node — re-enter the obs context each
+            # round so sim counters land in the live node's registry
+            with use_obs(self.node.obs):
+                if submitted < scenario.tasks:
+                    self._submit_task(submitted)
+                    submitted += 1
+                self._tick()
+                self._juror_pass()
+                self._sample_stakes()
+                if submitted >= scenario.tasks:
+                    pending = self._pending_jobs()
+                    if not pending and self.plane.pending_events() == 0:
+                        break
+                    if pending:
+                        due = [j for j in pending
+                               if j.waituntil <= self.clock.now]
+                        if not due:
+                            # nothing actionable now: jump to the next
+                            # deadline (claim / vote-finish windows)
+                            nxt = min(j.waituntil for j in pending)
+                            if nxt > self.clock.now:
+                                self.clock.advance(nxt - self.clock.now)
+                self.clock.advance(scenario.tick_seconds)
+                # a real chain produces blocks whether or not we
+                # transact; an empty block per round keeps the poll
+                # range moving so delayed/replayed logs actually flush
+                # (poll_events short-circuits when latest < next_block)
+                self.engine.mine_block()
+        else:
+            result.quiescent = False
+        result.rounds = rounds
+        result.journal_events.extend(self.node.obs.journal.events())
+        self.plane.armed = False
+        return result
+
+
+def run_scenario(scenario: Scenario, seed: int, *,
+                 db_path: str = ":memory:",
+                 node_cls: type[MinerNode] = MinerNode) -> SimResult:
+    """Build a world, drive the scenario to quiescence, return the
+    auditable result. `node_cls` lets regression tests inject a
+    deliberately buggy node (tests/test_sim.py double-commit)."""
+    return SimHarness(scenario, seed, db_path=db_path,
+                      node_cls=node_cls).run()

@@ -1,0 +1,328 @@
+"""SIM1xx invariant checkers — the audit that makes a scenario a test.
+
+Every checker consumes the `SimResult` bundle (decoded tx audit trace,
+commitment plaintext registry, obs journal, node db, engine terminal
+state) and returns findings. A clean run returns none; any finding is a
+protocol-invariant violation and the run's `--seed`/`--scenario` pair
+reproduces it byte-identically.
+
+  SIM101  task conservation    every delivered task ends in exactly one
+                               accounted terminal state (claimed /
+                               contested-resolved / invalid /
+                               quarantined); strict scenarios narrow the
+                               allowed set per task class
+  SIM102  commit before reveal every revealed solution's commitment
+                               landed in a strictly earlier block
+  SIM103  no duplicate commit  one (validator, taskid) never signals
+                               commitments for two different CIDs
+  SIM104  stake never negative no validator stake ever sampled below 0
+  SIM105  retries bounded      every journaled retry obeys expretry's
+                               tries bound and exact capped backoff curve
+  SIM106  CID crash-stability  a commitment signalled before a crash is
+                               revealed with the SAME CID after reboot
+  SIM107  token conservation   ledger sums to total supply; the engine
+                               stays solvent for stakes+escrow+fees
+  SIM108  liveness             the scenario drained inside its round
+                               bound
+
+The checkers are deliberately redundant with the engine's own reverts
+(defense in depth): their job is to catch a *node* that violates the
+protocol in ways the chain happens to accept — the injected
+double-commit regression in tests/test_sim.py proves SIM103 does.
+"""
+# detlint: enforce[DET101,DET102,DET103,DET105]
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from arbius_tpu.l0.commitment import generate_commitment
+from arbius_tpu.node.retry import BASE as RETRY_BASE
+
+
+@dataclass
+class SimFinding:
+    """One invariant violation. Shaped for the shared lint plumbing:
+    `.rule` feeds the stderr triage table, `.text()` the report lines,
+    `.to_json()` the stable JSON document (analysis.cli.render_json)."""
+    rule: str
+    message: str
+    taskid: str | None = None
+    scenario: str = ""
+    seed: int = 0
+
+    def text(self) -> str:
+        where = f" task={self.taskid}" if self.taskid else ""
+        return (f"{self.rule} [scenario={self.scenario} seed={self.seed}"
+                f"{where}] {self.message}")
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "message": self.message,
+                "taskid": self.taskid, "scenario": self.scenario,
+                "seed": self.seed}
+
+
+def _failed_methods_by_task(db) -> dict[str, list[str]]:
+    out: dict[str, list[str]] = {}
+    for method, data in db.failed_jobs():
+        tid = data.get("taskid")
+        if tid:
+            out.setdefault(tid, []).append(method)
+    return out
+
+
+def classify_tasks(result) -> dict[str, str]:
+    """One terminal label per submitted task (precedence order: dispute
+    outcome > chain solution state > node-local verdicts)."""
+    labels: dict[str, str] = {}
+    failed = _failed_methods_by_task(result.db)
+    for tid in result.tasks:
+        tb = bytes.fromhex(tid[2:])
+        sol = result.engine.solutions.get(tb)
+        con = result.engine.contestations.get(tb)
+        if tid not in result.plane.delivered_taskids:
+            labels[tid] = "undelivered"
+            continue
+        if con is not None:
+            if con.finish_start_index > 0:
+                labels[tid] = "contested_resolved"
+            elif "voteFinish" in failed.get(tid, ()):
+                labels[tid] = "quarantined"
+            else:
+                labels[tid] = "contested_unresolved"
+        elif sol is not None:
+            if sol.claimed:
+                labels[tid] = "claimed"
+            elif failed.get(tid):
+                labels[tid] = "quarantined"
+            else:
+                labels[tid] = "unclaimed"
+        elif result.db.is_invalid_task(tid):
+            labels[tid] = "invalid"
+        elif failed.get(tid):
+            labels[tid] = "quarantined"
+        else:
+            labels[tid] = "lost"
+    return labels
+
+
+# terminal states that account for a task (anything else is a leak)
+_ALWAYS_BAD = ("contested_unresolved", "unclaimed", "lost", "undelivered")
+
+
+def _allowed_labels(flags, strict: bool) -> tuple[str, ...]:
+    if flags.invalid:
+        return ("invalid",) if strict else ("invalid", "quarantined")
+    if flags.evil:
+        return ("contested_resolved",) if strict else (
+            "contested_resolved", "quarantined")
+    return ("claimed",) if strict else (
+        "claimed", "quarantined", "contested_resolved")
+
+
+def check_task_conservation(result, find) -> None:
+    labels = classify_tasks(result)
+    for tid, flags in result.tasks.items():
+        label = labels[tid]
+        allowed = _allowed_labels(flags, result.scenario.strict)
+        if label in _ALWAYS_BAD or label not in allowed:
+            tb = bytes.fromhex(tid[2:])
+            sol = result.engine.solutions.get(tb)
+            con = result.engine.contestations.get(tb)
+            detail = (f"solution="
+                      f"{('cid 0x' + sol.cid.hex() + ' by ' + sol.validator + (' claimed' if sol.claimed else ' UNCLAIMED')) if sol else 'none'}"
+                      f", contestation="
+                      f"{('finish_start_index ' + str(con.finish_start_index)) if con else 'none'}")
+            find("SIM101", tid,
+                 f"task leaked: terminal state {label!r} not in allowed "
+                 f"{list(allowed)} (class: "
+                 f"{'invalid-input' if flags.invalid else 'front-run' if flags.evil else 'normal'}"
+                 f"; {detail})")
+
+
+def _miner_writes(result, method: str):
+    return [r for r in result.plane.audit
+            if r.ok and r.method == method
+            and r.sender == result.miner_address]
+
+
+def check_commit_before_reveal(result, find) -> None:
+    commits = {r.values[0]: r
+               for r in _miner_writes(result, "signalCommitment")}
+    for rev in _miner_writes(result, "submitSolution"):
+        taskid, cid = rev.values
+        tid = "0x" + taskid.hex()
+        expected = generate_commitment(result.miner_address, taskid, cid)
+        commit = commits.get(expected)
+        if commit is None:
+            find("SIM102", tid,
+                 f"solution 0x{cid.hex()} revealed at block {rev.block} "
+                 "with NO matching signalCommitment in the audit trace")
+        elif commit.block >= rev.block:
+            find("SIM102", tid,
+                 f"commit landed at block {commit.block} but the reveal "
+                 f"landed at block {rev.block} — commit must be strictly "
+                 "earlier")
+
+
+def check_no_duplicate_commitment(result, find) -> None:
+    landed_blocks = {r.values[0]: r.block
+                     for r in _miner_writes(result, "signalCommitment")}
+    per_task: dict[tuple[str, str], dict[str, int]] = {}
+    for chash, (sender, tid, cid) in result.plane.commitments.items():
+        if chash not in landed_blocks or sender != result.miner_address:
+            continue
+        per_task.setdefault((sender, tid), {})[cid] = landed_blocks[chash]
+    for (sender, tid), cids in per_task.items():
+        if len(cids) > 1:
+            listing = ", ".join(f"{cid} @ block {blk}"
+                                for cid, blk in sorted(cids.items()))
+            find("SIM103", tid,
+                 f"validator {sender} signalled {len(cids)} different "
+                 f"commitments for one task — a double-commit: {listing}")
+
+
+def check_stake_never_negative(result, find) -> None:
+    if result.min_stake_seen < 0:
+        find("SIM104", None,
+             f"validator stake sampled below zero mid-run: "
+             f"{result.min_stake_seen}")
+    for addr, v in result.engine.validators.items():
+        if v.staked < 0:
+            find("SIM104", None,
+                 f"terminal stake negative for {addr}: {v.staked}")
+
+
+def check_retries_bounded(result, find) -> None:
+    cap = result.retry_max_delay
+    for ev in result.journal_events:
+        if ev.get("kind") != "retry":
+            continue
+        attempt, tries = ev.get("attempt", 0), ev.get("tries", 0)
+        if attempt > tries:
+            find("SIM105", ev.get("taskid"),
+                 f"retry op={ev.get('op')} attempt {attempt} exceeds its "
+                 f"tries bound {tries}")
+            continue
+        expected = 0.0 if attempt >= tries else round(
+            min(RETRY_BASE ** (attempt - 1), cap), 6)
+        got = ev.get("delay", 0.0)
+        if got != expected:
+            find("SIM105", ev.get("taskid"),
+                 f"retry op={ev.get('op')} attempt {attempt}/{tries} slept "
+                 f"{got}s, expretry policy says {expected}s "
+                 f"(base {RETRY_BASE}, max_delay {cap})")
+
+
+def check_cid_stability(result, find) -> None:
+    """Crash-restart determinism: a commitment that landed before a
+    crash binds the CID the rebooted node must reveal."""
+    if result.scenario.faults.crash_after_commit is None:
+        return
+    if not result.plane.crash_seqs:
+        find("SIM106", None,
+             "scenario configured crash_after_commit="
+             f"{result.scenario.faults.crash_after_commit} but the node "
+             "never crashed — the schedule degenerated")
+        return
+    crash_seq = result.plane.crash_seqs[0]
+    pre_commits = {r.values[0] for r in result.plane.audit[:crash_seq]
+                   if r.ok and r.method == "signalCommitment"
+                   and r.sender == result.miner_address}
+    committed_cid = {}   # tid -> cid committed before the crash
+    for chash in pre_commits:
+        reg = result.plane.commitments.get(chash)
+        if reg is not None:
+            committed_cid[reg[1]] = reg[2]
+    crossed = 0
+    for rev in result.plane.audit[crash_seq:]:
+        if not (rev.ok and rev.method == "submitSolution"
+                and rev.sender == result.miner_address):
+            continue
+        tid = "0x" + rev.values[0].hex()
+        if tid not in committed_cid:
+            continue
+        crossed += 1
+        revealed = "0x" + rev.values[1].hex()
+        if revealed != committed_cid[tid]:
+            find("SIM106", tid,
+                 f"pre-crash commitment bound CID {committed_cid[tid]} "
+                 f"but the rebooted node revealed {revealed} — the "
+                 "sqlite checkpoint did not reproduce the solve")
+    if crossed == 0:
+        find("SIM106", None,
+             "node crashed but no pre-crash commitment was revealed "
+             "after the restart — the recovery path went unexercised")
+
+
+def check_token_conservation(result, find) -> None:
+    tok = result.engine.token
+    total = sum(tok.balances.values())
+    if total != tok.total_supply:
+        find("SIM107", None,
+             f"ledger out of balance: Σbalances {total} != total supply "
+             f"{tok.total_supply}")
+    eng = result.engine
+    obligations = (eng.accrued_fees
+                   + sum(v.staked for v in eng.validators.values())
+                   + sum(eng.withdraw_pending.values()))
+    held = tok.balance_of(eng.ADDRESS)
+    if held < obligations:
+        find("SIM107", None,
+             f"engine insolvent: holds {held} but owes {obligations} "
+             "(accrued fees + stakes + pending withdraws)")
+
+
+def check_liveness(result, find) -> None:
+    if not result.quiescent:
+        find("SIM108", None,
+             f"scenario did not drain within {result.scenario.max_rounds} "
+             f"rounds ({len(result.plane.audit)} writes audited, "
+             f"{result.plane.pending_events()} events still in flight)")
+
+
+CHECKERS = (
+    check_task_conservation,
+    check_commit_before_reveal,
+    check_no_duplicate_commitment,
+    check_stake_never_negative,
+    check_retries_bounded,
+    check_cid_stability,
+    check_token_conservation,
+    check_liveness,
+)
+
+
+def check_all(result) -> list[SimFinding]:
+    findings: list[SimFinding] = []
+    for checker in CHECKERS:
+        def find(rule: str, taskid: str | None, message: str) -> None:
+            findings.append(SimFinding(
+                rule=rule, message=message, taskid=taskid,
+                scenario=result.scenario.name, seed=result.seed))
+        checker(result, find)
+    return findings
+
+
+def summarize(result) -> dict:
+    """Deterministic per-run summary for reports (no wall-clock, no
+    object addresses — byte-identical for identical (scenario, seed))."""
+    labels = classify_tasks(result)
+    terminal: dict[str, int] = {}
+    for label in labels.values():
+        terminal[label] = terminal.get(label, 0) + 1
+    return {
+        "scenario": result.scenario.name,
+        "seed": result.seed,
+        "tasks": len(result.tasks),
+        "terminal": dict(sorted(terminal.items())),
+        "per_task": {tid: {"index": f.index, "invalid": f.invalid,
+                           "evil": f.evil, "state": labels[tid]}
+                     for tid, f in sorted(result.tasks.items())},
+        "faults_injected": dict(sorted(result.plane.fault_counts.items())),
+        "writes_audited": len(result.plane.audit),
+        "restarts": result.restarts,
+        "rounds": result.rounds,
+        "virtual_seconds": result.engine.now
+        - result.engine.start_block_time,
+        "quiescent": result.quiescent,
+    }

@@ -1,0 +1,131 @@
+"""Declarative scenarios: seed + fault schedule + workload spec.
+
+A `Scenario` is everything a run needs besides its seed: how many task
+lifecycles to drive, which fraction are adversarial (front-run with a
+wrong CID → contestation path) or malformed (hydration failure →
+invalid path), and the `FaultSpec` rates the fault plane draws against.
+All rates are *per-opportunity* probabilities evaluated on named rng
+streams, so two scenarios with one differing rate share every other
+decision at the same seed.
+
+The named catalog (`SCENARIOS`) is the tier-1 matrix: `clean` must end
+with every delivered task claimed (strict mode); the fault mixes must
+end with every task in exactly one accounted terminal state and every
+SIM1xx invariant intact. Reproduce any run byte-identically with
+`python -m arbius_tpu.sim --scenario <name> --seed <n>`.
+"""
+# detlint: enforce[DET101,DET102,DET103,DET105]
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Per-opportunity fault rates for the three I/O edges + crash."""
+
+    # -- chain RPC edge (FaultTransport) --------------------------------
+    tx_error_rate: float = 0.0        # sendRawTransaction fails BEFORE landing
+    tx_lost_response_rate: float = 0.0  # tx lands, response is dropped
+    view_error_rate: float = 0.0      # eth_call answers 5xx
+    poll_error_rate: float = 0.0      # eth_getLogs answers 5xx
+    latency_max: int = 0              # virtual seconds injected per RPC, 0..max
+    event_delay_rate: float = 0.0     # log held back 1-3 polls (reorders)
+    event_replay_rate: float = 0.0    # log delivered again next poll
+    reorg_every: int = 0              # every N polls, redeliver recent blocks
+    reorg_depth: int = 4              # how many trailing blocks a reorg replays
+    # -- pinner edge (SimPinner) ----------------------------------------
+    pin_fail_rate: float = 0.0        # pin request 5xx
+    pin_stall_seconds: int = 0        # virtual stall per pin attempt, 0..max
+    pin_mismatch_rate: float = 0.0    # service answers a different root CID
+    # -- runner edge (FaultyRunner) -------------------------------------
+    runner_slow_seconds: int = 0      # virtual seconds per solve, 0..max
+    runner_crash_rate: float = 0.0    # runner raises mid-batch
+    # -- process crash ---------------------------------------------------
+    crash_after_commit: int | None = None  # kill node after Nth commit lands
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    description: str = ""
+    tasks: int = 8                 # lifecycles to drive
+    fee_wad: int = 1               # task fee in wad (fees exercise splits)
+    evil_rate: float = 0.0         # fraction front-run with a wrong CID
+    invalid_rate: float = 0.0      # fraction submitted with broken input
+    strict: bool = False           # every normal task MUST end claimed
+    tick_seconds: int = 5          # virtual seconds between rounds
+    max_rounds: int = 600          # liveness bound (SIM108 if exceeded)
+    faults: FaultSpec = field(default_factory=FaultSpec)
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+    def with_tasks(self, tasks: int | None) -> "Scenario":
+        return self if tasks is None else replace(self, tasks=tasks)
+
+
+SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
+    Scenario(
+        name="clean",
+        description="no faults; strict: every delivered task must be "
+                    "solved, revealed, and claimed",
+        strict=True),
+    Scenario(
+        name="rpc-flap",
+        description="flaky endpoint: transport errors, lost tx "
+                    "responses, 5xx views/polls, injected latency",
+        faults=FaultSpec(tx_error_rate=0.12, tx_lost_response_rate=0.10,
+                         view_error_rate=0.03, poll_error_rate=0.15,
+                         latency_max=7)),
+    Scenario(
+        name="pin-fail",
+        description="pinning service misbehaves: 5xx, stalls, CID "
+                    "mismatches; slow solves ride along",
+        faults=FaultSpec(pin_fail_rate=0.30, pin_stall_seconds=5,
+                         pin_mismatch_rate=0.15, runner_slow_seconds=3)),
+    Scenario(
+        name="reorg",
+        description="event plane chaos: delayed + replayed logs and "
+                    "shallow log-replay reorgs every few polls",
+        faults=FaultSpec(event_delay_rate=0.25, event_replay_rate=0.20,
+                         reorg_every=3, reorg_depth=4)),
+    Scenario(
+        name="crash-restart",
+        description="node process killed right after its 2nd commit "
+                    "lands; rebooted from the sqlite checkpoint and must "
+                    "reveal the SAME CID (SIM106)",
+        tasks=6, strict=True,
+        faults=FaultSpec(crash_after_commit=2)),
+    Scenario(
+        name="contested",
+        description="an adversary front-runs half the tasks with a "
+                    "wrong CID; the node must contest, vote, and finish "
+                    "every dispute",
+        tasks=6, evil_rate=0.5, strict=True),
+    Scenario(
+        name="chaos",
+        description="everything at once, at moderated rates — the soak "
+                    "mix for tools/simsoak.py",
+        tasks=10, evil_rate=0.2, invalid_rate=0.2,
+        faults=FaultSpec(tx_error_rate=0.08, tx_lost_response_rate=0.05,
+                         poll_error_rate=0.10, latency_max=5,
+                         event_delay_rate=0.15, event_replay_rate=0.10,
+                         reorg_every=5, reorg_depth=3,
+                         pin_fail_rate=0.15, pin_stall_seconds=3,
+                         pin_mismatch_rate=0.05, runner_slow_seconds=3,
+                         runner_crash_rate=0.08)),
+)}
+
+# the acceptance matrix every PR must keep green (tests/test_sim.py)
+TIER1_MATRIX = ("clean", "rpc-flap", "pin-fail", "reorg",
+                "crash-restart", "contested")
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r} — known: "
+            f"{', '.join(sorted(SCENARIOS))}") from None

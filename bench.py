@@ -213,18 +213,23 @@ def main() -> None:
             left = OUTER_BUDGET_S - (time.perf_counter() - _T0) - reserve
             if attempt > 0:
                 left -= 60  # the backoff below spends reserve-bound time
-                if left < 420:
-                    _note(f"no further claim attempts: {left:.0f}s outer "
-                          "budget left after backoff + fallback reserve")
-                    break
+            if left < 420:
+                # EVERY attempt (the first included) needs ≥420 s of real
+                # outer budget: flooring a negative/exhausted `left` up to
+                # 420 used to launch a session the outer window could not
+                # contain — skip instead and fall through to the CPU
+                # fallback / replay backstops below
+                _note(f"no further claim attempts: {left:.0f}s outer "
+                      "budget left after backoff + fallback reserve")
+                break
+            if attempt > 0:
                 _note("backing off 60s before the next claim attempt")
                 time.sleep(60)
             attempt += 1
-            # every attempt (including the first — BENCH_OUTER_BUDGET_S
-            # must bound it too) fits inside the remaining outer budget;
-            # at the defaults attempt 1 gets ≈2580 s (OUTER − reserve),
-            # ample for a cold ladder's headline (~1100 s, r04 evidence)
-            stage_budget = int(min(SESSION_TIMEOUT_S, max(left, 420)))
+            # the attempt fits inside the remaining outer budget; at the
+            # defaults attempt 1 gets ≈2580 s (OUTER − reserve), ample
+            # for a cold ladder's headline (~1100 s, r04 evidence)
+            stage_budget = int(min(SESSION_TIMEOUT_S, left))
             _note(f"claim attempt {attempt} (stage budget {stage_budget}s)")
             n, p = _stream_stage(
                 "session", stage_budget,

@@ -76,3 +76,27 @@ def test_flash_extreme_logits():
     np.testing.assert_allclose(
         out, np.asarray(sp_attention_reference(q, k, v)), rtol=1e-5,
         atol=1e-5)
+
+
+def test_attention_impl_pinned_at_import_and_explicitly_settable():
+    """ISSUE satellite: the dispatch is pinned ONCE (env read at import);
+    in-process flips go through set_attention_impl, which validates and
+    returns the prior value for restore."""
+    import pytest
+
+    from arbius_tpu.ops import flash
+
+    assert flash.attention_impl() in flash.VALID_ATTN_IMPLS
+    prior = flash.set_attention_impl("einsum")
+    try:
+        assert flash.attention_impl() == "einsum"
+        with pytest.raises(ValueError, match="bogus"):
+            flash.set_attention_impl("bogus")
+        assert flash.attention_impl() == "einsum"  # rejected = unchanged
+    finally:
+        flash.set_attention_impl(prior)
+    assert flash.attention_impl() == prior
+    # None restores the env-pinned import-time value
+    flash.set_attention_impl("flash")
+    flash.set_attention_impl(None)
+    assert flash.attention_impl() == flash._read_attn_impl()

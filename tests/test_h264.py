@@ -291,3 +291,16 @@ def test_poc_type0_slice_header_parses():
     dy, dcb, _ = decode_idr_ipcm(w.bytes(), sps, pps)
     np.testing.assert_array_equal(dy, y)
     np.testing.assert_array_equal(dcb, c)
+
+
+def test_inter_predicted_input_rejected_not_truncated():
+    """ISSUE satellite: a VCL NAL the decoder can't reproduce (types 1-4,
+    inter/partitioned slices) must raise, not silently skip — skipping
+    matted a truncated clip from external avc1 files."""
+    data = encode_mp4_h264(_frames(2, 32, 32), fps=8)
+    i = data.index(b"mdat") + 4       # first sample: 4-byte len + NAL
+    assert data[i + 4] & 0x1F == 5    # our encoder emits IDR slices
+    bad = bytearray(data)
+    bad[i + 4] = (3 << 5) | 1         # rewrite as a non-IDR slice
+    with pytest.raises(ValueError, match="all-IDR"):
+        decode_h264_mp4_yuv(bytes(bad))

@@ -382,3 +382,102 @@ def test_delegated_validator_stake_seam():
     from arbius_tpu.node.config import ConfigError
     with pytest.raises(ConfigError, match="delegated_validator"):
         MiningConfig(delegated_validator="not-an-address")
+
+
+# -- lost-response recovery (found by simnet rpc-flap) ---------------------
+
+def _lost_response(fn):
+    """Wrap a chain tx method so it LANDS but the response is lost —
+    the classic flaky-endpoint failure the retry envelope then sees as
+    'already done' reverts."""
+    def wrapped(*args, **kwargs):
+        fn(*args, **kwargs)
+        raise OSError("sim: response lost after landing")
+    return wrapped
+
+
+def test_reveal_lost_response_still_schedules_claim():
+    eng, tok, chain, node, mid = build_world()
+    chain.submit_solution = _lost_response(chain.submit_solution)
+    tid = submit(eng, mid, fee=10 * WAD)
+    drain(node)
+    sol = eng.solutions[bytes.fromhex(tid[2:])]
+    assert sol.validator == MINER
+    # the reveal landed even though every attempt "failed": the node must
+    # recognize its own on-chain solution and keep the lifecycle going
+    assert node.metrics.solutions_submitted == 1
+    assert node.db.has_job("claim", {"taskid": tid})
+    eng.advance_time(2000 + 121)
+    drain(node)
+    assert node.metrics.solutions_claimed == 1
+
+
+def test_claim_lost_response_still_counts():
+    eng, tok, chain, node, mid = build_world()
+    tid = submit(eng, mid, fee=10 * WAD)
+    drain(node)
+    chain.claim_solution = _lost_response(chain.claim_solution)
+    eng.advance_time(2000 + 121)
+    drain(node)
+    assert eng.solutions[bytes.fromhex(tid[2:])].claimed
+    assert node.metrics.solutions_claimed == 1
+    # nothing quarantined: the exhausted retries resolved to success
+    assert node.db.failed_jobs() == []
+
+
+def test_reveal_never_landing_quarantines_visibly():
+    eng, tok, chain, node, mid = build_world()
+
+    def down(*a, **k):
+        raise OSError("sim: endpoint down")
+
+    chain.submit_solution = down
+    tid = submit(eng, mid)
+    drain(node)
+    # no silent drop: the solve job must land in failed_jobs (task
+    # conservation — simnet SIM101)
+    assert ("solve" in {m for m, d in node.db.failed_jobs()
+                        if d.get("taskid") == tid})
+    assert bytes.fromhex(tid[2:]) not in eng.solutions
+
+
+def test_stake_heartbeat_survives_chain_fault():
+    eng, tok, chain, node, mid = build_world()
+    orig = chain.validator_staked
+
+    def down():
+        raise OSError("sim: endpoint down")
+
+    chain.validator_staked = down
+    eng.advance_time(700)
+    drain(node)
+    # the job failed and was quarantined...
+    assert any(m == "validatorStake" for m, _ in node.db.failed_jobs())
+    # ...but the heartbeat re-queued itself (a dead stake loop would
+    # eventually deregister the validator — found by simnet rpc-flap)
+    assert node.db.has_job("validatorStake", {})
+    chain.validator_staked = orig
+    eng.advance_time(700)
+    drain(node)
+
+
+# -- attention-impl boot gate (ISSUE satellite: ops/flash.py) --------------
+
+def test_boot_gates_nondefault_attention_impl():
+    from arbius_tpu.ops import flash
+
+    eng, tok, chain, node, mid = build_world()
+    m = node.registry.get(mid)
+    node.registry.register(RegisteredModel(
+        id=mid, template=m.template, runner=m.runner,
+        golden=({"prompt": "g", "negative_prompt": ""}, 1,
+                "0x1220" + "00" * 32)))
+    prior = flash.set_attention_impl("einsum")
+    try:
+        # a non-default reduction order may only mine if the self-test
+        # proves the goldens still hold — skipping it must fail the boot
+        with pytest.raises(BootError, match="ARBIUS_ATTN_IMPL"):
+            node.boot(skip_self_test=True)
+    finally:
+        flash.set_attention_impl(prior)
+    assert flash.attention_impl() == prior

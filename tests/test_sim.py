@@ -1,0 +1,184 @@
+"""simnet tier-1 suite: the scenario matrix + checker regressions.
+
+The matrix (`TIER1_MATRIX` × seeds) is the standing gate: a real
+MinerNode over signed txs into the in-process devnet, under seeded
+fault schedules, must pass every SIM1xx invariant checker. The worlds
+are expensive (every chain write is a signed EIP-1559 tx), so the
+module-scoped `matrix` fixture runs each (scenario, seed) ONCE and
+every test audits the cached run. The injected double-commit proves
+the checkers can actually catch a violating node; the byte-identical-
+report test proves a failing seed reproduces.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from arbius_tpu.sim.bugs import DoubleCommitMinerNode
+from arbius_tpu.sim.cli import main as sim_main
+from arbius_tpu.sim.harness import SimHarness, run_scenario
+from arbius_tpu.sim.invariants import check_all, classify_tasks, summarize
+from arbius_tpu.sim.scenario import SCENARIOS, TIER1_MATRIX, get_scenario
+
+SEEDS = (1, 2)
+
+
+@pytest.fixture(scope="module")
+def matrix(tmp_path_factory):
+    """(scenario, seed) → (harness, result, findings) for the whole
+    acceptance matrix — run once, audited by every test below."""
+    base = tmp_path_factory.mktemp("simnet")
+    out = {}
+    for name in TIER1_MATRIX:
+        for seed in SEEDS:
+            h = SimHarness(get_scenario(name), seed,
+                           db_path=str(base / f"{name}-{seed}.sqlite"))
+            result = h.run()
+            out[(name, seed)] = (h, result, check_all(result))
+    return out
+
+
+# -- the acceptance matrix -------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("name", TIER1_MATRIX)
+def test_scenario_matrix_holds_every_invariant(matrix, name, seed):
+    _, result, findings = matrix[(name, seed)]
+    assert not findings, (
+        "invariant violations:\n  "
+        + "\n  ".join(f.text() for f in findings)
+        + f"\nreproduce byte-identically with: {result.repro()}")
+    assert result.quiescent
+    # every task accounted: exactly one terminal label each
+    labels = classify_tasks(result)
+    assert set(labels) == set(result.tasks)
+
+
+def test_clean_scenario_claims_everything(matrix):
+    _, result, findings = matrix[("clean", 1)]
+    assert not findings
+    assert set(classify_tasks(result).values()) == {"claimed"}
+    assert result.plane.fault_counts == {}
+
+
+def test_faulty_scenarios_actually_inject(matrix):
+    """A fault mix whose schedule degenerates to zero injections tests
+    nothing — pin the matrix scenarios to nonzero injection counts."""
+    for name in ("rpc-flap", "pin-fail", "reorg"):
+        for seed in SEEDS:
+            _, result, _ = matrix[(name, seed)]
+            assert sum(result.plane.fault_counts.values()) > 0, (name, seed)
+
+
+# -- crash-restart ---------------------------------------------------------
+
+def test_crash_restart_recovers_from_checkpoint(matrix):
+    _, result, findings = matrix[("crash-restart", 1)]
+    assert not findings
+    assert result.restarts == 1
+    assert result.plane.crash_seqs, "the crash never fired"
+    # the commitment that triggered the crash was revealed post-restart
+    # with the SAME CID (SIM106 verified it; assert the pair exists)
+    crash_seq = result.plane.crash_seqs[0]
+    pre = [r for r in result.plane.audit[:crash_seq]
+           if r.ok and r.method == "signalCommitment"
+           and r.sender == result.miner_address]
+    post_reveals = {("0x" + r.values[0].hex(), "0x" + r.values[1].hex())
+                    for r in result.plane.audit[crash_seq:]
+                    if r.ok and r.method == "submitSolution"
+                    and r.sender == result.miner_address}
+    crossed = [result.plane.commitments[r.values[0]] for r in pre
+               if (result.plane.commitments[r.values[0]][1],
+                   result.plane.commitments[r.values[0]][2])
+               in post_reveals]
+    assert crossed, "no pre-crash commitment was revealed after restart"
+    # and the run still claims every task
+    assert set(classify_tasks(result).values()) == {"claimed"}
+
+
+# -- contestation ----------------------------------------------------------
+
+def test_contested_scenario_slashes_the_adversary(matrix):
+    from arbius_tpu.chain.fixedpoint import WAD
+    from arbius_tpu.sim.harness import EVIL
+
+    _, result, findings = matrix[("contested", 1)]
+    assert not findings
+    evil_tasks = [tid for tid, f in result.tasks.items() if f.evil]
+    assert evil_tasks, "seed 1 produced no front-run tasks"
+    labels = classify_tasks(result)
+    for tid in evil_tasks:
+        assert labels[tid] == "contested_resolved"
+        con = result.engine.contestations[bytes.fromhex(tid[2:])]
+        assert con.finish_start_index > 0
+    # the adversary's escrow was slashed (yea side won 2-1), so its
+    # stake ends strictly below its 200 wad deposit
+    assert result.engine.validators[EVIL].staked < 200 * WAD
+
+
+# -- checker regressions ---------------------------------------------------
+
+def test_injected_double_commit_fails_closed(tmp_path):
+    result = run_scenario(get_scenario("clean").with_tasks(4), 0,
+                          db_path=str(tmp_path / "bug.sqlite"),
+                          node_cls=DoubleCommitMinerNode)
+    findings = check_all(result)
+    sim103 = [f for f in findings if f.rule == "SIM103"]
+    assert sim103, "the double-commit checker never fired"
+    # the invariant diff is readable: both CIDs with their blocks
+    msg = sim103[0].message
+    assert "double-commit" in msg
+    assert msg.count("0x1220") == 2
+    assert msg.count("@ block") == 2
+    assert sim103[0].taskid in result.tasks
+
+
+def test_reports_are_byte_identical_per_seed(matrix, tmp_path):
+    _, cached, _ = matrix[("rpc-flap", 1)]
+    fresh = run_scenario(get_scenario("rpc-flap"), 1,
+                         db_path=str(tmp_path / "fresh.sqlite"))
+    a = json.dumps(summarize(cached), sort_keys=True)
+    assert a == json.dumps(summarize(fresh), sort_keys=True)
+    _, other_seed, _ = matrix[("rpc-flap", 2)]
+    assert a != json.dumps(summarize(other_seed), sort_keys=True)
+
+
+# -- obs integration -------------------------------------------------------
+
+def test_fault_plane_counts_into_ambient_obs(matrix):
+    h, result, _ = matrix[("pin-fail", 1)]
+    counter = h.node.obs.registry.counter(
+        "arbius_sim_faults_total", labelnames=("kind",))
+    assert counter.value(kind="pin_fail") == \
+        result.plane.fault_counts["pin_fail"] > 0
+
+
+# -- CLI (shared lint exit contract) ---------------------------------------
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    assert sim_main(["--scenario", "clean", "--tasks", "3", "--json",
+                     "--workdir", str(tmp_path)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"] == [] and doc["version"] == 1
+    assert doc["runs"][0]["terminal"] == {"claimed": 3}
+    assert sim_main(["--scenario", "does-not-exist"]) == 2
+    capsys.readouterr()
+    assert sim_main(["--seeds", "0"]) == 2
+    capsys.readouterr()
+    assert sim_main(["--inject-bug", "no-such-bug"]) == 2
+    capsys.readouterr()
+    assert sim_main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in SCENARIOS:
+        assert name in out
+
+
+def test_cli_injected_bug_exits_1_with_repro_line(tmp_path, capsys):
+    rc = sim_main(["--inject-bug", "double-commit", "--tasks", "3",
+                   "--workdir", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "SIM103" in captured.out
+    # the failing run names its exact repro invocation
+    assert "--scenario clean --seed 0" in captured.err

@@ -200,3 +200,37 @@ def test_cli_cid(tmp_path, capsys):
     assert main(["cid", str(f)]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["cid"].startswith("Qm")
+
+
+def test_jacobian_point_mul_matches_affine_reference():
+    """_point_mul runs in Jacobian coordinates (one inversion per
+    multiply); the affine _point_add ladder is the reference it must
+    never drift from."""
+    import hashlib
+
+    from arbius_tpu.chain.wallet import (
+        GX,
+        GY,
+        N,
+        _point_add,
+        _point_mul,
+    )
+
+    def affine_mul(k, point=(GX, GY)):
+        result, addend = None, point
+        while k:
+            if k & 1:
+                result = _point_add(result, addend)
+            addend = _point_add(addend, addend)
+            k >>= 1
+        return result
+
+    scalars = [1, 2, 3, N - 1, N // 2, 0x10000000000000000] + [
+        int.from_bytes(hashlib.sha256(f"k{i}".encode()).digest(), "big") % N
+        for i in range(8)]
+    q = _point_mul(987654321)
+    for k in scalars:
+        assert _point_mul(k) == affine_mul(k)
+        assert _point_mul(k, q) == affine_mul(k, q)
+    assert _point_mul(0) is None
+    assert _point_mul(N) is None      # N·G = infinity

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""simsoak — deterministic fault-injection soak of the miner lifecycle.
+
+Pre-commit / CI front door for `arbius_tpu.sim` (scenario catalog,
+fault plane, and SIM1xx invariant list in docs/fault-injection.md):
+drives a real MinerNode over the signed-tx JSON-RPC stack against the
+in-process devnet under seeded fault schedules, then audits the run
+against the protocol invariants.
+
+    python tools/simsoak.py                          # clean, seed 0
+    python tools/simsoak.py --scenario tier1 --seeds 2   # the CI matrix
+    python tools/simsoak.py --scenario chaos --seed 41 --json
+    python tools/simsoak.py --list                   # scenario catalog
+    python tools/simsoak.py --inject-bug double-commit   # must exit 1
+
+Exit codes: 0 clean / 1 invariant violations / 2 usage error —
+identical contract to detlint.py / graphlint.py; all three are shells
+over tools/_common.py's `lint_main`. Every failing run prints the
+`--scenario`/`--seed` pair that reproduces it byte-identically.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import lint_main
+
+from arbius_tpu.sim.cli import build_arg_parser, collect, render
+
+
+def main(argv=None) -> int:
+    return lint_main("simsoak", __doc__, build_arg_parser, collect, render,
+                     argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -256,16 +256,19 @@ def main() -> None:
               "error": f"setup: {type(e).__name__}: {e}"})
         impls = ()
     # restore the operator's pinned impl afterwards, not "auto" — the
-    # remaining probes (vae/full_generate/trace) must run under the env
-    # the operator launched with
-    prior_impl = os.environ.get("ARBIUS_ATTN_IMPL")
+    # remaining probes (vae/full_generate/trace) must run under the
+    # dispatch the operator launched with. The impl is pinned at import
+    # (ops/flash.py); the A/B threads each candidate through the explicit
+    # setter and re-jits, the one legitimate way to flip it in-process.
+    from arbius_tpu.ops.flash import set_attention_impl
+
     for impl in impls:
         if impl != "auto" and _left(deadline) < 240:
             _note(f"skipping unet A/B impl={impl} (budget)")
             continue
         hb.set(f"segment: unet step (CFG) attn={impl}")
+        prior_impl = set_attention_impl(impl)
         try:
-            os.environ["ARBIUS_ATTN_IMPL"] = impl
             un = jax.jit(lambda p, x, t, c: pipe.unet.apply(
                 {"params": p}, x, t, c))
             sec = _timeit(un, params["unet"], xin, t, ctx)
@@ -276,10 +279,7 @@ def main() -> None:
             emit({"probe": "segment", "name": "unet_step_cfg",
                   "attn_impl": impl, "error": f"{type(e).__name__}: {e}"})
         finally:
-            if prior_impl is None:
-                os.environ.pop("ARBIUS_ATTN_IMPL", None)
-            else:
-                os.environ["ARBIUS_ATTN_IMPL"] = prior_impl
+            set_attention_impl(prior_impl)
 
     # VAE decode alone
     hb.set("segment: vae decode")
