@@ -10,6 +10,13 @@ makes it *rarely* visible, not correct.
   CONC301  an attribute is written in one method and accessed from a
            `threading.Thread(target=self.<m>)` body (or vice versa)
            with neither side holding a lock
+  CONC302  a `queue.Queue()` (or Lifo/PriorityQueue) constructed without
+           a positive `maxsize` inside `arbius_tpu/node/` — the node's
+           stage buffers exist to exert backpressure, and an unbounded
+           queue silently converts a slow consumer into unbounded
+           memory growth instead of a stalled producer
+           (node/pipeline.py `enforce`s this rule: its hand-off queues
+           can never go unbounded, not even via baseline rot)
 
 Heuristics that keep the rule honest:
 
@@ -200,3 +207,50 @@ def unlocked_shared_attribute(ctx: FileContext):
                        f"`{tgt}` without a held lock — GIL scheduling "
                        "decides who wins")
                 break  # one finding per attribute
+
+
+_QUEUE_CTORS = ("queue.Queue", "queue.LifoQueue", "queue.PriorityQueue")
+
+
+@rule("CONC302", "warning",
+      "unbounded queue.Queue in node code defeats backpressure")
+def unbounded_queue(ctx: FileContext):
+    """Node-scoped by path: the rule is about the miner's stage buffers
+    (arbius_tpu/node/), not about queues in general — tools and tests
+    may buffer freely."""
+    if not ctx.path.startswith("arbius_tpu/node/"):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.canonical(node.func) not in _QUEUE_CTORS:
+            continue
+        bound = None
+        if node.args:
+            bound = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                bound = kw.value
+        if bound is None:
+            yield (node.lineno, node.col_offset,
+                   "queue.Queue() without maxsize is an unbounded "
+                   "buffer — node stage queues must bound their depth "
+                   "so a slow consumer stalls its producer instead of "
+                   "growing memory")
+            continue
+        # literal non-positive bounds (incl. `-1`, a USub around the
+        # literal) mean "infinite" in the stdlib queue module
+        value = bound
+        negate = False
+        if isinstance(value, ast.UnaryOp) and \
+                isinstance(value.op, ast.USub):
+            value, negate = value.operand, True
+        if not isinstance(value, ast.Constant):
+            continue
+        v = value.value
+        if negate and isinstance(v, (int, float)):
+            v = -v
+        if v is None or (isinstance(v, (int, float)) and v <= 0):
+            yield (node.lineno, node.col_offset,
+                   f"queue maxsize={v!r} means UNBOUNDED in the "
+                   "stdlib queue module — pass a positive bound")

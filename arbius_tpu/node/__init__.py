@@ -11,6 +11,7 @@ from arbius_tpu.node.config import (
     DeploymentConfig,
     MiningConfig,
     ModelConfig,
+    PipelineConfig,
     StakeConfig,
     load_config,
     load_deployment,
@@ -39,7 +40,8 @@ __all__ = [
     "ContentStore", "DeploymentConfig", "HttpDaemonPinner", "Job",
     "Kandinsky2Runner", "LocalChain", "LocalPinner", "MinerNode",
     "MiningConfig", "ModelConfig", "ModelRegistry", "NodeDB",
-    "NodeMetrics", "Obs", "PinMismatchError", "RVMRunner", "RegisteredModel",
+    "NodeMetrics", "Obs", "PinMismatchError", "PipelineConfig",
+    "RVMRunner", "RegisteredModel",
     "RetriesExhausted", "RpcChain", "SD15Runner", "StakeConfig",
     "Text2VideoRunner", "build_registry", "cid_b58", "expretry",
     "load_config", "load_deployment", "solve_cid", "solve_files",

@@ -85,6 +85,37 @@ class StakeConfig:
 
 
 @dataclass(frozen=True)
+class PipelineConfig:
+    """Staged solve executor (docs/pipeline.md): decouples device
+    compute, host encode+CID, and network pin/commit so the chip never
+    waits for the host+network tail of the previous bucket.
+
+    Disabled by default — `enabled: false` IS the reference-equivalent
+    synchronous path (one bucket at a time, commit before the next
+    dispatch). The knobs only change the *schedule*, never the bytes:
+    solution CIDs are identical pipeline-on vs pipeline-off
+    (tests/test_pipeline.py pins this per runner family)."""
+    enabled: bool = False
+    # how many canonical_batch chunks may be dispatched to the device
+    # ahead of the encode stage (generalizes the old one-deep overlap)
+    depth: int = 2
+    # host worker threads for encode+CID; 0 = encode inline on the tick
+    # thread (still pipelined against the chip via async dispatch)
+    encode_workers: int = 0
+    # backpressure bound on tasks queued for the network stage
+    # (pin + commit/reveal) before the driver drains them
+    max_inflight_pins: int = 4
+
+    def __post_init__(self):
+        if self.depth < 1:
+            raise ConfigError("pipeline.depth must be >= 1")
+        if self.encode_workers < 0:
+            raise ConfigError("pipeline.encode_workers must be >= 0")
+        if self.max_inflight_pins < 1:
+            raise ConfigError("pipeline.max_inflight_pins must be >= 1")
+
+
+@dataclass(frozen=True)
 class IpfsConfig:
     """Pinning strategy selection (reference `types.ts:3-54` ipfs section):
     local = the node's own ContentStore + gateway (needs store_dir);
@@ -92,11 +123,16 @@ class IpfsConfig:
     strategy: str = "local"
     daemon_url: str = ""
     pinata_jwt: str = ""
+    # per-pinner HTTP timeout in seconds — reaches every remote pin
+    # request (build_pinner threads it through); 60 matches the old
+    # hard-coded constant
     timeout: float = 60.0
 
     def __post_init__(self):
         if self.strategy not in ("local", "http_daemon", "pinata"):
             raise ConfigError(f"unknown ipfs strategy {self.strategy!r}")
+        if self.timeout <= 0:
+            raise ConfigError("ipfs.timeout must be positive seconds")
         if self.strategy == "http_daemon" and not self.daemon_url:
             raise ConfigError("ipfs strategy http_daemon needs daemon_url")
         if self.strategy == "pinata" and not self.pinata_jwt:
@@ -137,6 +173,9 @@ class MiningConfig:
     store_dir: str | None = None     # content store root (None: don't pin)
     rpc_port: int | None = None      # control RPC + explorer + /ipfs gateway
     ipfs: IpfsConfig = IpfsConfig()  # pinning strategy
+    # staged solve executor (docs/pipeline.md); default OFF = the
+    # synchronous reference-equivalent path behind a single switch
+    pipeline: PipelineConfig = PipelineConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -214,6 +253,7 @@ def load_config(raw: str | dict) -> MiningConfig:
     automine = build(AutomineConfig, obj.pop("automine", {}), "automine")
     stake = build(StakeConfig, obj.pop("stake", {}), "stake")
     ipfs = build(IpfsConfig, obj.pop("ipfs", {}), "ipfs")
+    pipeline = build(PipelineConfig, obj.pop("pipeline", {}), "pipeline")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
-                      ipfs=ipfs, **obj), "config")
+                      ipfs=ipfs, pipeline=pipeline, **obj), "config")

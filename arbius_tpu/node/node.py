@@ -137,8 +137,24 @@ class MinerNode:
         reg.gauge("arbius_queue_depth",
                   "Jobs currently in the queue (due or waiting)",
                   fn=self.db.job_count)
+        self._c_idle = reg.counter(
+            "arbius_chip_idle_seconds_total",
+            "Seconds the solve path spent with nothing dispatched on the "
+            "device (the host+network tail the pipeline exists to hide)")
         self.metrics = NodeMetrics(self.obs)
         self._retry_sleep = lambda s: None  # injectable; chain time is fake
+        self._pipeline = None
+        if config.pipeline.enabled:
+            from arbius_tpu.node.pipeline import SolvePipeline
+
+            self._pipeline = SolvePipeline(self, config.pipeline)
+
+    def close(self) -> None:
+        """Release owned resources: encode pool threads, then the sqlite
+        handle. Safe to call more than once."""
+        if self._pipeline is not None:
+            self._pipeline.shutdown()
+        self.db.close()
 
     # -- boot (start.ts:11-52 + index.ts:971-1020) -----------------------
     def boot(self, *, skip_self_test: bool = False) -> None:
@@ -323,15 +339,18 @@ class MinerNode:
         done = 0
         concurrent = [j for j in jobs if j.concurrent]
         serial = [j for j in jobs if not j.concurrent]
-        for job in concurrent:
-            done += self._run_job(job)
-        # dp batching: group due solve jobs into one XLA dispatch
-        solves = [j for j in serial if j.method == "solve"]
-        others = [j for j in serial if j.method != "solve"]
-        if solves:
-            done += self._process_solve_batch(solves)
-        for job in others:
-            done += self._run_job(job)
+        # one tick = one sqlite commit: the claim/delete cycle below
+        # used to fsync per job (docs/pipeline.md, db.batch())
+        with self.db.batch():
+            for job in concurrent:
+                done += self._run_job(job)
+            # dp batching: group due solve jobs into one XLA dispatch
+            solves = [j for j in serial if j.method == "solve"]
+            others = [j for j in serial if j.method != "solve"]
+            if solves:
+                done += self._process_solve_batch(solves)
+            for job in others:
+                done += self._run_job(job)
         return done
 
     def _run_job(self, job: Job) -> int:
@@ -456,6 +475,15 @@ class MinerNode:
             by_bucket.setdefault(
                 self._bucket_key(job.data["model"], hydrated), []).append(
                 (job, hydrated))
+        if self._pipeline is not None and not self.config.evilmode:
+            # staged executor (docs/pipeline.md): same buckets, same
+            # chunking, same bytes — a pipelined schedule. evilmode (a
+            # contestation drill that fabricates CIDs without solving)
+            # stays on the reference-shaped path below.
+            buckets = [(self.registry.get(model_id), entries)
+                       for (model_id, *_), entries in by_bucket.items()]
+            with span("solve.pipeline", n=sum(len(e) for _, e in buckets)):
+                return self._pipeline.run(buckets)
         done = 0
         for (model_id, *_), entries in by_bucket.items():
             m = self.registry.get(model_id)
@@ -499,7 +527,12 @@ class MinerNode:
                 log.warning("solve commit failed: %r", e)
                 self._fail_job(job, e)
         # detlint: allow[DET101] obs stage timing; never reaches solve bytes
-        self._h_stage.observe(time.perf_counter() - w_commit, stage="commit")
+        commit_seconds = time.perf_counter() - w_commit
+        self._h_stage.observe(commit_seconds, stage="commit")
+        # on the synchronous path the whole pin/commit tail runs with
+        # nothing dispatched on the device — that window IS chip idle
+        # (the pipeline's A/B comparison baseline, docs/pipeline.md)
+        self._c_idle.inc(commit_seconds)
         return done
 
     def _store_solution(self, taskid: str, cid: str, files: dict) -> None:
@@ -585,23 +618,51 @@ class MinerNode:
 
         return jax.profiler.trace(cfg.profile_dir)
 
-    def _commit_reveal(self, taskid: str, cid: str, t_start: int) -> None:
+    def _commit_reveal(self, taskid: str, cid: str, t_start: int, *,
+                       progress=None, skip_commit: bool = False) -> None:
         """index.ts:566-672: skip if solved (contest on CID mismatch —
         the reference merely bails, index.ts:568-579; contesting here is
-        strictly more vigilant), else commit → reveal → queue claim."""
+        strictly more vigilant), else commit → reveal → queue claim.
+
+        `progress(stage, resumed=...)` is the pipeline's checkpoint hook,
+        called AFTER each chain write is known to have landed (commit,
+        then reveal) — never before, so a recorded stage is always true.
+        `skip_commit` resumes past a commitment the sqlite checkpoint
+        proves landed in a previous life (same CID; re-signalling would
+        only round-trip into the engine's already-signalled revert)."""
+        if progress is None:
+            progress = lambda stage, resumed=False: None  # noqa: E731
         existing = self.chain.get_solution(taskid)
         if existing is not None:
-            if "0x" + existing.cid.hex() != cid and \
-                    existing.validator != self.chain.address:
-                self.db.mark_invalid_task(taskid)
-                self.db.queue_job("contest", {"taskid": taskid}, priority=50)
+            if "0x" + existing.cid.hex() != cid:
+                if existing.validator != self.chain.address:
+                    self.db.mark_invalid_task(taskid)
+                    self.db.queue_job("contest", {"taskid": taskid},
+                                      priority=50)
+                return
+            if existing.validator == self.chain.address:
+                # our own reveal from a previous life (crash after the
+                # reveal landed but before the claim was scheduled) —
+                # finish the bookkeeping instead of stranding the reward
+                progress("reveal", resumed=True)
+                if not existing.claimed and \
+                        not self.db.has_job("claim", {"taskid": taskid}):
+                    self.db.queue_job(
+                        "claim", {"taskid": taskid},
+                        waituntil=self.chain.now
+                        + self.chain.min_claim_solution_time()
+                        + self.config.claim_delay_buffer)
             return
-        with span("solve.commit", taskid=taskid):
-            commitment = self.chain.generate_commitment(taskid, cid)
-            try:
-                self.chain.signal_commitment(commitment)
-            except EngineError:
-                pass  # already signalled (e.g. replay); reveal decides
+        if skip_commit:
+            progress("commit", resumed=True)
+        else:
+            with span("solve.commit", taskid=taskid):
+                commitment = self.chain.generate_commitment(taskid, cid)
+                try:
+                    self.chain.signal_commitment(commitment)
+                except EngineError:
+                    pass  # already signalled (e.g. replay); reveal decides
+            progress("commit")
         try:
             with span("solve.reveal", taskid=taskid):
                 expretry(lambda: self.chain.submit_solution(taskid, cid),
@@ -626,6 +687,7 @@ class MinerNode:
             # saw "solution already submitted" for our own solution) —
             # fall through to the success bookkeeping, or the claim
             # would never be scheduled (found by simnet rpc-flap)
+        progress("reveal")
         self._inc("solutions_submitted")
         self._h_latency.observe(self.chain.now - t_start, tag=taskid)
         self.db.queue_job(

@@ -54,6 +54,22 @@ class PinMismatchError(RuntimeError):
     """Remote daemon returned a different root CID than computed locally."""
 
 
+def multipart_request(url: str, chunks: list[bytes], boundary: str,
+                      headers: dict | None = None) -> urllib.request.Request:
+    """POST whose body is a LIST of chunks: each solution file rides as
+    its own chunk, referenced rather than copied into one contiguous
+    buffer — peak memory stays ~1× the output bytes instead of the 2×
+    the old `b"".join` cost on multi-MB video outputs. urllib sends any
+    iterable body chunk-by-chunk but requires an explicit
+    Content-Length for it, so we compute one here."""
+    h = {"Content-Type": f"multipart/form-data; boundary={boundary}",
+         "Content-Length": str(sum(len(c) for c in chunks))}
+    if headers:
+        h.update(headers)
+    return urllib.request.Request(url, data=chunks, headers=h,
+                                  method="POST")
+
+
 class HttpDaemonPinner:
     """kubo `/api/v0/add` with the reference's exact options
     (`miner/src/ipfs.ts:11-16`): cid-version=0, sha2-256, 262144 chunker,
@@ -67,7 +83,7 @@ class HttpDaemonPinner:
         self.timeout = timeout
         self.opener = opener or urllib.request.urlopen
 
-    def _multipart(self, files: dict[str, bytes]) -> bytes:
+    def _multipart(self, files: dict[str, bytes]) -> list[bytes]:
         parts = []
         for name in sorted(files):
             parts.append(
@@ -75,20 +91,18 @@ class HttpDaemonPinner:
                  f'Content-Disposition: form-data; name="file"; '
                  f'filename="{name}"\r\n'
                  "Content-Type: application/octet-stream\r\n\r\n"
-                 ).encode() + files[name] + b"\r\n")
+                 ).encode())
+            parts.append(files[name])   # referenced, never copied
+            parts.append(b"\r\n")
         parts.append(f"--{self.BOUNDARY}--\r\n".encode())
-        return b"".join(parts)
+        return parts
 
     def pin_files(self, files: dict[str, bytes], taskid: str = "") -> bytes:
         local_root = cid_of_solution_files(files)
         query = ("cid-version=0&hash=sha2-256&chunker=size-262144"
                  "&raw-leaves=false&wrap-with-directory=true&pin=true")
-        req = urllib.request.Request(
-            f"{self.api_url}/api/v0/add?{query}",
-            data=self._multipart(files),
-            headers={"Content-Type":
-                     f"multipart/form-data; boundary={self.BOUNDARY}"},
-            method="POST")
+        req = multipart_request(f"{self.api_url}/api/v0/add?{query}",
+                                self._multipart(files), self.BOUNDARY)
         with span("pin.files", strategy="http_daemon", n=len(files),
                   taskid=taskid or None), \
                 self.opener(req, timeout=self.timeout) as r:
@@ -107,12 +121,9 @@ class HttpDaemonPinner:
         local = dag_of_file(content).cid
         query = ("cid-version=0&hash=sha2-256&chunker=size-262144"
                  "&raw-leaves=false&pin=true")
-        req = urllib.request.Request(
-            f"{self.api_url}/api/v0/add?{query}",
-            data=self._multipart({filename: content}),
-            headers={"Content-Type":
-                     f"multipart/form-data; boundary={self.BOUNDARY}"},
-            method="POST")
+        req = multipart_request(f"{self.api_url}/api/v0/add?{query}",
+                                self._multipart({filename: content}),
+                                self.BOUNDARY)
         with span("pin.blob", strategy="http_daemon", size=len(content)), \
                 self.opener(req, timeout=self.timeout) as r:
             lines = [json.loads(l) for l in r.read().splitlines() if l]
@@ -141,7 +152,7 @@ class PinataPinner:
         self.opener = opener or urllib.request.urlopen
         self.api_url = api_url or self.API_URL
 
-    def _multipart(self, files: dict[str, bytes], taskid: str) -> bytes:
+    def _multipart(self, files: dict[str, bytes], taskid: str) -> list[bytes]:
         parts = []
         for name in sorted(files):
             parts.append(
@@ -149,23 +160,22 @@ class PinataPinner:
                  f'Content-Disposition: form-data; name="file"; '
                  f'filename="{taskid}/{name}"\r\n'
                  "Content-Type: application/octet-stream\r\n\r\n"
-                 ).encode() + files[name] + b"\r\n")
+                 ).encode())
+            parts.append(files[name])   # referenced, never copied
+            parts.append(b"\r\n")
         parts.append(
             (f"--{self.BOUNDARY}\r\n"
              'Content-Disposition: form-data; name="pinataOptions"\r\n\r\n'
              + json.dumps({"cidVersion": 0}) + "\r\n").encode())
         parts.append(f"--{self.BOUNDARY}--\r\n".encode())
-        return b"".join(parts)
+        return parts
 
     def pin_files(self, files: dict[str, bytes], taskid: str = "task") -> bytes:
         local_root = cid_of_solution_files(files)
-        req = urllib.request.Request(
-            self.api_url,
-            data=self._multipart(files, taskid or "task"),
-            headers={"Content-Type":
-                     f"multipart/form-data; boundary={self.BOUNDARY}",
-                     "Authorization": f"Bearer {self.jwt}"},
-            method="POST")
+        req = multipart_request(
+            self.api_url, self._multipart(files, taskid or "task"),
+            self.BOUNDARY,
+            headers={"Authorization": f"Bearer {self.jwt}"})
         with span("pin.files", strategy="pinata", n=len(files),
                   taskid=taskid or None), \
                 self.opener(req, timeout=self.timeout) as r:
@@ -184,18 +194,17 @@ class PinataPinner:
              f'Content-Disposition: form-data; name="file"; '
              f'filename="{filename}"\r\n'
              "Content-Type: application/octet-stream\r\n\r\n"
-             ).encode() + content + b"\r\n",
+             ).encode(),
+            content,                    # referenced, never copied
+            b"\r\n",
             (f"--{self.BOUNDARY}\r\n"
              'Content-Disposition: form-data; name="pinataOptions"\r\n\r\n'
              + json.dumps({"cidVersion": 0}) + "\r\n").encode(),
             f"--{self.BOUNDARY}--\r\n".encode(),
         ]
-        req = urllib.request.Request(
-            self.api_url, data=b"".join(parts),
-            headers={"Content-Type":
-                     f"multipart/form-data; boundary={self.BOUNDARY}",
-                     "Authorization": f"Bearer {self.jwt}"},
-            method="POST")
+        req = multipart_request(
+            self.api_url, parts, self.BOUNDARY,
+            headers={"Authorization": f"Bearer {self.jwt}"})
         with span("pin.blob", strategy="pinata", size=len(content)), \
                 self.opener(req, timeout=self.timeout) as r:
             got = json.loads(r.read()).get("IpfsHash")

@@ -90,16 +90,28 @@ def solve_files_batch(model: RegisteredModel, items: list[tuple[dict, int]],
                                   canonical_batch=canonical_batch)
 
 
-def _solve_files_batch(model: RegisteredModel, items: list[tuple[dict, int]],
-                       *, canonical_batch: int = 1) -> list[dict]:
-    run_batch = getattr(model.runner, "run_batch", None)
-    if run_batch is None or canonical_batch <= 1:
-        return [solve_files(model, h, s) for h, s in items]
+def chunk_items(items: list[tuple[dict, int]],
+                canonical_batch: int) -> list[tuple[list, int]]:
+    """Split a bucket's items into canonical_batch-sized chunks, padding
+    the last chunk by repeating its final real item — every dispatch runs
+    the exact fleet-wide batch size (one bucket ⇒ one XLA program ⇒ one
+    determinism class). Returns [(padded_items, n_real)]. Shared by the
+    serial path below and the staged executor (node/pipeline.py) so the
+    two schedules can never chunk differently."""
     chunks = []
     for start in range(0, len(items), canonical_batch):
         chunk = items[start:start + canonical_batch]
         real = len(chunk)
         chunks.append((chunk + [chunk[-1]] * (canonical_batch - real), real))
+    return chunks
+
+
+def _solve_files_batch(model: RegisteredModel, items: list[tuple[dict, int]],
+                       *, canonical_batch: int = 1) -> list[dict]:
+    run_batch = getattr(model.runner, "run_batch", None)
+    if run_batch is None or canonical_batch <= 1:
+        return [solve_files(model, h, s) for h, s in items]
+    chunks = chunk_items(items, canonical_batch)
     out: list[dict] = []
     dispatch = getattr(model.runner, "dispatch", None)
     finalize = getattr(model.runner, "finalize", None)

@@ -27,7 +27,8 @@ class DoubleCommitMinerNode(MinerNode):
         flipped = format(int(cid[-1], 16) ^ 0x1, "x")
         return cid[:-1] + flipped
 
-    def _commit_reveal(self, taskid: str, cid: str, t_start: int) -> None:
+    def _commit_reveal(self, taskid: str, cid: str, t_start: int,
+                       **kwargs) -> None:
         if self.chain.get_solution(taskid) is None:
             wrong = self._corrupt(cid)
             second = self.chain.generate_commitment(taskid, wrong)
@@ -35,7 +36,7 @@ class DoubleCommitMinerNode(MinerNode):
                 self.chain.signal_commitment(second)
             except (EngineError, DevnetError):  # pragma: no cover
                 pass
-        super()._commit_reveal(taskid, cid, t_start)
+        super()._commit_reveal(taskid, cid, t_start, **kwargs)
 
 
 INJECTABLE_BUGS = {

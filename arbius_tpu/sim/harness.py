@@ -51,6 +51,7 @@ from arbius_tpu.node import (
     NodeDB,
     RegisteredModel,
 )
+from arbius_tpu.node.config import PipelineConfig
 from arbius_tpu.node.solver import EVIL_CID
 from arbius_tpu.obs import use_obs
 from arbius_tpu.sim.clock import VirtualClock
@@ -111,6 +112,9 @@ class SimResult:
     restarts: int = 0
     retry_max_delay: float = 30.0
     miner_address: str = ""
+    # the matrix runs the staged solve executor (docs/pipeline.md);
+    # SIM109 audits its journaled stage order only when it actually ran
+    pipeline_enabled: bool = False
 
     def repro(self) -> str:
         return (f"python -m arbius_tpu.sim --scenario "
@@ -121,7 +125,8 @@ class SimResult:
 class SimHarness:
     def __init__(self, scenario: Scenario, seed: int,
                  db_path: str = ":memory:",
-                 node_cls: type[MinerNode] = MinerNode):
+                 node_cls: type[MinerNode] = MinerNode,
+                 pipeline: bool = True):
         if scenario.faults.crash_after_commit is not None \
                 and db_path == ":memory:":
             # a restart from :memory: builds an EMPTY NodeDB — the run
@@ -136,6 +141,7 @@ class SimHarness:
         self.seed = seed
         self.db_path = db_path
         self.node_cls = node_cls
+        self.pipeline = pipeline
 
         self.token = TokenLedger()
         self.engine = Engine(self.token, start_time=START_TIME)
@@ -204,7 +210,18 @@ class SimHarness:
             models=(ModelConfig(id=self.model_id, template="anythingv3"),),
             compile_cache_dir=None,
             obs_journal_capacity=16384,
-            retry_max_delay=self.result.retry_max_delay)
+            retry_max_delay=self.result.retry_max_delay,
+            # the staged executor runs under EVERY scenario's fault mix
+            # by default (docs/pipeline.md): real encode worker threads,
+            # a 2-deep device window, a bounded network backlog —
+            # SIM101-108 must hold unchanged and SIM109 audits the stage
+            # order. pipeline=False drives the shipped synchronous
+            # default through the same fault plane (tests/test_sim.py
+            # runs both so neither schedule's path rots uncovered).
+            pipeline=PipelineConfig(enabled=True, depth=2,
+                                    encode_workers=2, max_inflight_pins=2)
+            if self.pipeline else PipelineConfig())
+        self.result.pipeline_enabled = self.pipeline
         registry = ModelRegistry()
         registry.register(RegisteredModel(
             id=self.model_id, template=load_template("anythingv3"),
@@ -224,7 +241,7 @@ class SimHarness:
         db's INSERT OR IGNORE absorbs the replayed history)."""
         self.result.journal_events.extend(self.node.obs.journal.events())
         self.result.restarts += 1
-        self.node.db.close()
+        self.node.close()   # encode pool + sqlite handle
         armed = self.plane.armed
         self.plane.armed = False     # boot is not under fault injection
         try:
@@ -337,15 +354,22 @@ class SimHarness:
             result.quiescent = False
         result.rounds = rounds
         result.journal_events.extend(self.node.obs.journal.events())
+        if self.node._pipeline is not None:
+            # stop the encode pool; the db handle stays open — the
+            # invariant checkers still audit it through the result
+            self.node._pipeline.shutdown()
         self.plane.armed = False
         return result
 
 
 def run_scenario(scenario: Scenario, seed: int, *,
                  db_path: str = ":memory:",
-                 node_cls: type[MinerNode] = MinerNode) -> SimResult:
+                 node_cls: type[MinerNode] = MinerNode,
+                 pipeline: bool = True) -> SimResult:
     """Build a world, drive the scenario to quiescence, return the
     auditable result. `node_cls` lets regression tests inject a
-    deliberately buggy node (tests/test_sim.py double-commit)."""
+    deliberately buggy node (tests/test_sim.py double-commit);
+    `pipeline=False` runs the shipped synchronous solve path instead of
+    the staged executor."""
     return SimHarness(scenario, seed, db_path=db_path,
-                      node_cls=node_cls).run()
+                      node_cls=node_cls, pipeline=pipeline).run()

@@ -24,6 +24,15 @@ reproduces it byte-identically.
                                stays solvent for stakes+escrow+fees
   SIM108  liveness             the scenario drained inside its round
                                bound
+  SIM109  stage monotonicity   per task, the staged solve executor's
+                               journaled pipeline_stage ranks never
+                               regress inside one node life (solve →
+                               encode → pin → commit → reveal); a crash
+                               boundary may reset them (the reboot
+                               re-executes from the checkpoint), and a
+                               pipeline-enabled run that solved tasks
+                               but journaled NO stage events is itself
+                               a finding (the executor went unexercised)
 
 The checkers are deliberately redundant with the engine's own reverts
 (defense in depth): their job is to catch a *node* that violates the
@@ -280,6 +289,56 @@ def check_liveness(result, find) -> None:
              f"{result.plane.pending_events()} events still in flight)")
 
 
+def check_stage_order(result, find) -> None:
+    """SIM109: the staged executor's per-task lifecycle must advance
+    monotonically through solve → encode → pin → commit → reveal inside
+    one node life. A `sim_crash` journal event marks a reboot — the
+    recovered node legitimately re-executes earlier stages, so the
+    per-task high-water marks reset there."""
+    if not getattr(result, "pipeline_enabled", False):
+        return
+    from arbius_tpu.node.pipeline import STAGE_RANK
+
+    # keyed per (task, solve-job attempt): replayed chain events
+    # legitimately queue duplicate solve jobs for an already-solved
+    # task, and each attempt re-walks the stages from the top — within
+    # one attempt the ranks must never regress
+    last: dict[tuple, tuple[int, str]] = {}
+    saw_any = False
+    for ev in result.journal_events:
+        kind = ev.get("kind")
+        if kind == "sim_crash":
+            last.clear()
+            continue
+        if kind != "pipeline_stage":
+            continue
+        saw_any = True
+        tid, stage = ev.get("taskid"), ev.get("stage")
+        rank = STAGE_RANK.get(stage)
+        if rank is None:
+            find("SIM109", tid,
+                 f"unknown pipeline stage {stage!r} in the journal")
+            continue
+        key = (tid, ev.get("jobid"))
+        prev = last.get(key)
+        if prev is not None and rank < prev[0]:
+            find("SIM109", tid,
+                 f"stage order regressed within solve attempt "
+                 f"{ev.get('jobid')}: {stage!r} (rank {rank}) journaled "
+                 f"after {prev[1]!r} (rank {prev[0]}) with no crash "
+                 "boundary between them")
+            continue
+        last[key] = (rank, stage)
+    if not saw_any and any(
+            r.ok and r.method == "signalCommitment"
+            and r.sender == result.miner_address
+            for r in result.plane.audit):
+        find("SIM109", None,
+             "pipeline enabled and the node committed solutions, but the "
+             "journal holds no pipeline_stage events — the staged "
+             "executor went unexercised")
+
+
 CHECKERS = (
     check_task_conservation,
     check_commit_before_reveal,
@@ -289,6 +348,7 @@ CHECKERS = (
     check_cid_stability,
     check_token_conservation,
     check_liveness,
+    check_stage_order,
 )
 
 

@@ -117,9 +117,12 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
                          runner_crash_rate=0.08)),
 )}
 
-# the acceptance matrix every PR must keep green (tests/test_sim.py)
+# the acceptance matrix every PR must keep green (tests/test_sim.py) —
+# the FULL catalog since the staged solve executor landed: chaos (every
+# fault at once) is exactly the mix that would expose a pipeline
+# ordering bug, so it gates tier-1 too
 TIER1_MATRIX = ("clean", "rpc-flap", "pin-fail", "reorg",
-                "crash-restart", "contested")
+                "crash-restart", "contested", "chaos")
 
 
 def get_scenario(name: str) -> Scenario:

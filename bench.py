@@ -421,10 +421,120 @@ def _stage_tiny(out_path: str) -> None:
         "stage": "tiny",
         "elapsed_s": round(time.perf_counter() - _T0, 1),
     })
+    try:
+        _pipeline_ab(out_path, pipe, params, platform, hb)
+    except Exception as e:  # the A/B row is additive — never fail tiny
+        _note(f"pipeline_ab stage failed: {type(e).__name__}: {e}")
     hb.stop()
     # teardown on a wedged tunnel can hang ~1500 s (round-3 postmortem);
     # nothing left to do, so skip interpreter teardown entirely.
     os._exit(0)
+
+
+def _pipeline_ab(out_path: str, pipe, params, platform: str, hb) -> None:
+    """pipeline_ab sub-stage (docs/pipeline.md): the REAL MinerNode tick
+    loop drives the same tiny solves with the staged executor OFF then
+    ON, reporting chip-idle seconds and solutions/hour per mode plus the
+    obs registry snapshot (stage queue depths, chip-idle counter). CPU
+    sanity numbers only — clearly labeled, no perf claim."""
+    import json as _json
+
+    from arbius_tpu.chain import WAD, Engine, TokenLedger
+    from arbius_tpu.node import (
+        LocalChain,
+        MinerNode,
+        MiningConfig,
+        ModelConfig,
+        ModelRegistry,
+        RegisteredModel,
+        SD15Runner,
+    )
+    from arbius_tpu.node.config import PipelineConfig
+    from arbius_tpu.node.solver import solve_cid_batch
+    from arbius_tpu.templates.engine import hydrate_input, load_template
+
+    N, BATCH = 8, 2
+    tmpl = load_template("anythingv3")
+    raw = {"prompt": "pipeline ab warmup", "negative_prompt": "",
+           "width": 128, "height": 128, "num_inference_steps": 4}
+    hb.set("pipeline_ab: warmup compile (tiny batch=2)")
+    warm_model = RegisteredModel(id="0x" + "00" * 32, template=tmpl,
+                                 runner=SD15Runner(pipe, params))
+    hyd = hydrate_input(dict(raw), tmpl)
+    # both modes then run warm executables — the A/B compares schedules,
+    # not compile luck
+    solve_cid_batch(warm_model, [(hyd, 1), (hyd, 2)], canonical_batch=BATCH)
+
+    def run_mode(pcfg: PipelineConfig, label: str) -> dict:
+        tok = TokenLedger()
+        eng = Engine(tok, start_time=10_000)
+        tok.mint(Engine.ADDRESS, 600_000 * WAD)
+        miner, user = "0x" + "aa" * 20, "0x" + "01" * 20
+        for a in (miner, user):
+            tok.mint(a, 1_000 * WAD)
+            tok.approve(a, Engine.ADDRESS, 10**30)
+        mid = "0x" + eng.register_model(user, user, 0, b"{}").hex()
+        registry = ModelRegistry()
+        registry.register(RegisteredModel(
+            id=mid, template=tmpl, runner=SD15Runner(pipe, params)))
+        chain = LocalChain(eng, miner)
+        chain.validator_deposit(100 * WAD)
+        node = MinerNode(
+            chain,
+            MiningConfig(models=(ModelConfig(id=mid,
+                                             template="anythingv3"),),
+                         canonical_batch=BATCH, compile_cache_dir=None,
+                         pipeline=pcfg),
+            registry)
+        node.boot(skip_self_test=True)
+        while node.tick():
+            pass
+        for i in range(N):
+            eng.submit_task(user, 0, user, bytes.fromhex(mid[2:]), 0,
+                            _json.dumps(dict(raw, prompt=f"ab task {i}"),
+                                        sort_keys=True).encode())
+        hb.set(f"pipeline_ab: {label} mode ({N} solves)")
+        t0 = time.perf_counter()
+        for _ in range(64):
+            if node.tick() == 0:
+                break
+        elapsed = time.perf_counter() - t0
+        assert len(eng.solutions) == N, f"{label}: {len(eng.solutions)}/{N}"
+        reg = node.obs.registry
+        snap = {k: v for k, v in reg.summary().items()
+                if k.startswith(("arbius_pipeline_", "arbius_chip_idle",
+                                 "arbius_db_commit", "arbius_stage_"))}
+        out = {
+            "solutions": N,
+            "seconds": round(elapsed, 3),
+            "solutions_per_hour": round(3600.0 * N / elapsed, 2),
+            "chip_idle_seconds": round(
+                reg.counter("arbius_chip_idle_seconds_total").value(), 4),
+            "obs": snap,
+        }
+        node.close()
+        return out
+
+    on_cfg = PipelineConfig(enabled=True, depth=2, encode_workers=2,
+                            max_inflight_pins=2)
+    # one discarded pass per mode first: tiny CPU solves are ~50 ms, so
+    # cache/allocator warmth would otherwise dominate the comparison
+    run_mode(PipelineConfig(), "off-warm")
+    run_mode(on_cfg, "on-warm")
+    off = run_mode(PipelineConfig(), "off")
+    on = run_mode(on_cfg, "on")
+    _emit(out_path, {
+        "metric": "pipeline_ab_tiny_solutions_per_hour",
+        "value": on["solutions_per_hour"],
+        "unit": (f"solutions/hour (TINY 128x128x4 through the full node "
+                 f"tick loop, canonical_batch={BATCH}, platform="
+                 f"{platform} — CPU A/B sanity, no perf claim)"),
+        "vs_baseline": 0.0,
+        "note": "pipeline_ab: staged executor on vs off, same bytes",
+        "stage": "pipeline_ab",
+        "modes": {"off": off, "on": on},
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+    })
 
 
 def _prod_line(val: float, unit: str, note: str, stage: str,

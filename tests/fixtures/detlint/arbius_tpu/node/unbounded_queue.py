@@ -1,0 +1,18 @@
+"""detlint golden fixture — CONC302 unbounded-queue variants.
+
+Lives under a fake `arbius_tpu/node/` prefix because CONC302 is scoped
+to the miner's own stage buffers. Every bare construction below is a
+deliberate violation; do not "fix" them.
+"""
+import queue
+from queue import LifoQueue, Queue as Q
+
+work = queue.Queue()                 # no maxsize: unbounded
+alias = Q()                          # alias resolution must still catch it
+lifo = LifoQueue(maxsize=0)          # stdlib 0 means infinite
+prio = queue.PriorityQueue(maxsize=-1)   # negative is infinite too
+
+bounded = queue.Queue(maxsize=8)     # fine: real backpressure
+positional = queue.Queue(4)          # fine: positional bound
+configured = queue.Queue(maxsize=max(1, 2))  # fine: non-literal bound
+allowed = queue.Queue()  # detlint: allow[CONC302] drained same-tick, test rig

@@ -320,6 +320,65 @@ def test_conc301_module_level_lock_recognized():
     assert not check(src)
 
 
+_NODE_PY = "arbius_tpu/node/somefile.py"   # CONC302 is node/-scoped
+
+
+def test_conc302_unbounded_queue_in_node_scope():
+    src = "import queue\nq = queue.Queue()\n"
+    hits = analyze_source(src, _NODE_PY)
+    assert rules_of(hits) == ["CONC302"]
+    assert "backpressure" in hits[0].message or \
+        "unbounded" in hits[0].message
+    # the same construct outside arbius_tpu/node/ is not a finding:
+    # tools and tests may buffer freely
+    assert not analyze_source(src, "tools/somefile.py")
+    assert not analyze_source(src, "snippet.py")
+    # outside enforce[]'d files the finding is baselineable like any
+    # other (snippet-keyed, reason-mandatory)
+    bl = baseline_mod.update(hits, None)
+    assert len(bl.entries) == 1 and not bl.apply(hits)
+
+
+def test_conc302_literal_zero_and_negative_are_unbounded():
+    src = ("import queue\nfrom queue import LifoQueue\n"
+           "a = queue.Queue(maxsize=0)\n"
+           "b = LifoQueue(-1)\n"
+           "c = queue.PriorityQueue(maxsize=None)\n")
+    hits = analyze_source(src, _NODE_PY)
+    assert rules_of(hits) == ["CONC302"] * 3
+
+
+def test_conc302_bounded_and_dynamic_are_clean():
+    assert not analyze_source(
+        "import queue\n"
+        "a = queue.Queue(maxsize=8)\n"
+        "b = queue.Queue(4)\n"
+        "c = queue.Queue(maxsize=max(1, depth))\n", _NODE_PY)
+
+
+def test_conc302_fixture_golden_json():
+    got = _json_report([str(FIXDIR / "arbius_tpu")], str(FIXDIR))
+    want = (FIXDIR / "unbounded_queue.golden.json").read_text()
+    assert got == want
+    doc = json.loads(got)
+    assert [f["rule"] for f in doc["findings"]] == ["CONC302"] * 4
+    # the pragma'd construction in the fixture was absorbed by allow[]
+    assert not any("allowed" in f["snippet"] for f in doc["findings"])
+
+
+def test_conc302_enforced_in_pipeline_cannot_be_waived():
+    """node/pipeline.py enforces CONC302: an unbounded queue added there
+    is fatal even with a pragma, and the baseline refuses to absorb it."""
+    src = (REPO / "arbius_tpu/node/pipeline.py").read_text()
+    assert not analyze_source(src, "arbius_tpu/node/pipeline.py"), \
+        "pipeline.py should be clean"
+    evil = src + ("\n_overflow = queue.Queue()"
+                  "  # detlint: allow[CONC302] nope\n")
+    hits = analyze_source(evil, "arbius_tpu/node/pipeline.py")
+    assert any(f.rule == "CONC302" and f.enforced for f in hits)
+    assert not baseline_mod.update(hits, None).entries
+
+
 # -- suppressions, enforce, LINT001 -----------------------------------------
 
 def test_inline_suppression_same_line_and_above():
@@ -461,6 +520,7 @@ def test_solve_path_files_declare_enforcement():
         ("arbius_tpu/node/solver.py",
          {"DET101", "DET102", "DET103", "DET104", "DET105"}),
         ("arbius_tpu/node/retry.py", {"DET101", "DET102", "DET105"}),
+        ("arbius_tpu/node/pipeline.py", {"CONC302"}),
     ]:
         d = parse_directives((REPO / rel).read_text())
         assert d.enforced == must, f"{rel} enforce[] list drifted"

@@ -31,6 +31,13 @@ FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 FILES = {"out-1.png": b"\x89PNG fake" * 32}
 
 
+def _body(req) -> bytes:
+    """Join a request's chunked multipart body (pinners send a list of
+    chunks so file bytes are referenced, not copied)."""
+    d = req.data
+    return d if isinstance(d, bytes) else b"".join(d)
+
+
 # -- config knob -----------------------------------------------------------
 
 def test_ipfs_config_defaults_to_local():
@@ -100,7 +107,7 @@ def test_pinata_pinner_pins_and_verifies():
     req = seen[0]
     assert req.full_url == PinataPinner.API_URL
     assert req.get_header("Authorization") == "Bearer test-jwt"
-    body = req.data.decode("latin-1")
+    body = _body(req).decode("latin-1")
     assert 'filename="0xabc/out-1.png"' in body
     assert '"cidVersion": 0' in body
 
@@ -110,6 +117,53 @@ def test_pinata_pinner_rejects_mismatched_root():
         [{"IpfsHash": "QmWrongHash"}], []))
     with pytest.raises(PinMismatchError):
         pinner.pin_files(FILES)
+
+
+# -- multipart body: chunked, not copied; timeout: configured --------------
+
+def test_multipart_body_references_file_bytes():
+    """The multipart body is a chunk list whose payload entries ARE the
+    solution's bytes objects (no contiguous join — peak memory stays ~1×
+    the output size for multi-MB videos), with an explicit
+    Content-Length covering every chunk (urllib's iterable-body
+    contract)."""
+    files = {"out-1.mp4": b"\x00\x01" * 4096, "out-2.png": b"\x89PNG" * 64}
+    for pinner, answer in ((HttpDaemonPinner("http://127.0.0.1:1"), b""),
+                           (PinataPinner("jwt"), b"{}")):
+        seen: list = []
+        pinner.opener = lambda req, timeout=None, _a=answer: (
+            seen.append(req), io.BytesIO(_a))[1]
+        with pytest.raises(PinMismatchError):
+            pinner.pin_files(dict(files))
+        req = seen[0]
+        assert not isinstance(req.data, bytes)
+        chunk_ids = {id(c) for c in req.data}
+        for blob in files.values():
+            assert id(blob) in chunk_ids, "file bytes were copied"
+        assert int(req.get_header("Content-length")) == \
+            sum(len(c) for c in req.data)
+
+
+def test_ipfs_timeout_threads_from_config_to_request():
+    """MiningConfig.ipfs.timeout reaches every remote pin call — the
+    old hard-coded 60 s is just the schema default now."""
+    cfg = load_config({"ipfs": {"strategy": "http_daemon",
+                                "daemon_url": "http://127.0.0.1:1",
+                                "timeout": 7.5}})
+    pinner = build_pinner(cfg.ipfs, None)
+    assert pinner.timeout == 7.5
+    seen: list = []
+
+    def opener(req, timeout=None):
+        seen.append(timeout)
+        return io.BytesIO(b"")
+
+    pinner.opener = opener
+    with pytest.raises(PinMismatchError):
+        pinner.pin_files(FILES)
+    assert seen == [7.5]
+    with pytest.raises(ConfigError, match="timeout"):
+        load_config({"ipfs": {"timeout": 0}})
 
 
 # -- node integration: each strategy drives _store_solution -----------------
@@ -124,7 +178,7 @@ class _EchoOpener:
     def __call__(self, req, timeout=None):
         self.reqs.append(req)
         files = {}
-        for part in req.data.split(b"--" + PinataPinner.BOUNDARY.encode()):
+        for part in _body(req).split(b"--" + PinataPinner.BOUNDARY.encode()):
             if b'name="file"' not in part:
                 continue
             head, _, body = part.partition(b"\r\n\r\n")
@@ -170,7 +224,7 @@ def test_node_mines_with_http_daemon_strategy(tmp_path):
         def __call__(self, req, timeout=None):
             self.reqs.append(req)
             files = {}
-            for part in req.data.split(
+            for part in _body(req).split(
                     b"--" + HttpDaemonPinner.BOUNDARY.encode()):
                 if b'name="file"' not in part:
                     continue

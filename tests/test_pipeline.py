@@ -1,0 +1,433 @@
+"""solvepipe — the staged solve executor (arbius_tpu/node/pipeline.py).
+
+The load-bearing property is BYTE EQUALITY: solution files and CIDs must
+be identical pipeline-on vs pipeline-off for every runner family the
+fakes cover (SD15-shaped dispatch/finalize runners and RVM-shaped plain
+callables), at canonical_batch 1 and 4 — the pipeline may only change
+the schedule, never the bytes. The simnet crash-mid-pipeline test proves
+restart-from-checkpoint loses no task and never double-commits.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from arbius_tpu.chain import Engine, TokenLedger, WAD
+from arbius_tpu.l0.cid import cid_hex, cid_of_solution_files
+from arbius_tpu.node import (
+    LocalChain,
+    MinerNode,
+    MiningConfig,
+    ModelConfig,
+    ModelRegistry,
+    RegisteredModel,
+)
+from arbius_tpu.node.config import ConfigError, PipelineConfig, load_config
+from arbius_tpu.templates.engine import load_template
+from tests.test_node import MINER, MODEL_ADDR, USER, drain, submit, task_input
+
+PIPE_ON = PipelineConfig(enabled=True, depth=2, encode_workers=2,
+                         max_inflight_pins=2)
+
+
+class _RecordingPinner:
+    """Captures the exact bytes every task pinned (the byte-equality
+    oracle) while answering like a well-behaved service."""
+
+    def __init__(self):
+        self.pinned: dict[str, dict] = {}
+
+    def pin_files(self, files: dict, taskid: str = "") -> bytes:
+        self.pinned[taskid] = dict(files)
+        return cid_of_solution_files(files)
+
+    def pin_blob(self, content: bytes, filename: str = "input") -> bytes:
+        from arbius_tpu.l0.cid import dag_of_file
+
+        return dag_of_file(content).cid
+
+
+class _SD15FakeRunner:
+    """SD15Runner-shaped: dispatch/finalize split, run_batch, callable —
+    deterministic PNG-ish bytes from (input, seed). Logs the schedule so
+    tests can assert the overlap actually happened."""
+
+    def __init__(self, log=None):
+        self.log = log if log is not None else []
+
+    def __call__(self, hydrated, seed):
+        return self.finalize(self.dispatch([(hydrated, seed)]), 1)[0]
+
+    def run_batch(self, items):
+        return self.finalize(self.dispatch(items), len(items))
+
+    def dispatch(self, items):
+        self.log.append(("dispatch", len(items)))
+        return [self._bytes(h, s) for h, s in items]
+
+    def finalize(self, dev, n_real):
+        self.log.append(("finalize", n_real))
+        return [{"out-1.png": dev[i]} for i in range(n_real)]
+
+    @staticmethod
+    def _bytes(hydrated, seed):
+        blob = json.dumps({k: v for k, v in sorted(hydrated.items())
+                           if k != "seed"}).encode()
+        return b"\x89PNG" + blob + seed.to_bytes(8, "big")
+
+
+class _RVMFakeRunner:
+    """RVMRunner-shaped: a plain callable with NO batch/dispatch
+    surface, seed-independent like the real matting model (the runner
+    interface family is what's under test; the declared output name
+    follows the test template)."""
+
+    def __call__(self, hydrated, seed):
+        blob = json.dumps({k: v for k, v in sorted(hydrated.items())
+                           if k != "seed"}).encode()
+        return {"out-1.png": b"\x00\x00\x00 ftypisom" + blob}
+
+
+def _world(runner, *, pipeline=None, canonical_batch=1):
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=10_000)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    for a in (MINER, USER):
+        tok.mint(a, 1_000 * WAD)
+        tok.approve(a, Engine.ADDRESS, 10**30)
+    mid_b = eng.register_model(USER, MODEL_ADDR, 0, b'{"meta":{}}')
+    mid = "0x" + mid_b.hex()
+    registry = ModelRegistry()
+    registry.register(RegisteredModel(
+        id=mid, template=load_template("anythingv3"), runner=runner))
+    chain = LocalChain(eng, MINER)
+    chain.validator_deposit(100 * WAD)
+    cfg = MiningConfig(
+        models=(ModelConfig(id=mid, template="anythingv3"),),
+        canonical_batch=canonical_batch,
+        pipeline=pipeline or PipelineConfig())
+    pinner = _RecordingPinner()
+    node = MinerNode(chain, cfg, registry, pinner=pinner)
+    node.boot()
+    drain(node)
+    return eng, node, mid, pinner
+
+
+def _mine(runner_cls, *, pipeline, canonical_batch, n_tasks=5):
+    """Drive n_tasks through one world; returns {taskid: (cid, files)}."""
+    eng, node, mid, pinner = _world(runner_cls(), pipeline=pipeline,
+                                    canonical_batch=canonical_batch)
+    tids = [submit(eng, mid, prompt=f"task {i}") for i in range(n_tasks)]
+    drain(node)
+    out = {}
+    for tid in tids:
+        sol = eng.solutions[bytes.fromhex(tid[2:])]
+        out[tid] = ("0x" + sol.cid.hex(), pinner.pinned.get(tid))
+    node.close()
+    return out
+
+
+# -- byte equality: the golden acceptance gate ------------------------------
+
+@pytest.mark.parametrize("batch", [1, 4])
+@pytest.mark.parametrize("runner_cls", [_SD15FakeRunner, _RVMFakeRunner])
+def test_cids_and_bytes_identical_pipeline_on_vs_off(runner_cls, batch):
+    off = _mine(runner_cls, pipeline=None, canonical_batch=batch)
+    on = _mine(runner_cls, pipeline=PIPE_ON, canonical_batch=batch)
+    # identical chain writes are impossible across two engines, but the
+    # task ids are (same submitter nonce chain) — compare directly
+    assert off.keys() == on.keys()
+    for tid in off:
+        cid_off, files_off = off[tid]
+        cid_on, files_on = on[tid]
+        assert cid_off == cid_on, f"CID drift for {tid}"
+        assert files_off == files_on, f"byte drift for {tid}"
+        # and the CID really is the hash of the pinned bytes
+        assert cid_on == cid_hex(cid_of_solution_files(files_on))
+
+
+def test_inline_encode_mode_matches_too():
+    """encode_workers=0 keeps everything on the tick thread (no pool);
+    bytes still identical, chip overlap still via async dispatch."""
+    inline = PipelineConfig(enabled=True, depth=3, encode_workers=0,
+                            max_inflight_pins=1)
+    off = _mine(_SD15FakeRunner, pipeline=None, canonical_batch=4)
+    on = _mine(_SD15FakeRunner, pipeline=inline, canonical_batch=4)
+    assert off == on
+
+
+# -- schedule: the chip window actually fills ------------------------------
+
+def test_depth_k_prefetch_dispatches_ahead():
+    log = []
+    eng, node, mid, _ = _world(
+        _SD15FakeRunner(log), canonical_batch=2,
+        pipeline=PipelineConfig(enabled=True, depth=2, encode_workers=0,
+                                max_inflight_pins=8))
+    for i in range(6):
+        submit(eng, mid, prompt=f"t{i}")
+    log.clear()
+    drain(node)
+    kinds = [k for k, _ in log]
+    # 3 chunks, window 2: the second dispatch precedes the first
+    # finalize, and the window refills before the second finalize
+    assert kinds == ["dispatch", "dispatch", "finalize", "dispatch",
+                     "finalize", "finalize"]
+    node.close()
+
+
+def test_pipeline_stage_events_are_monotonic_per_task():
+    eng, node, mid, _ = _world(_SD15FakeRunner(), canonical_batch=2,
+                               pipeline=PIPE_ON)
+    tids = [submit(eng, mid, prompt=f"t{i}") for i in range(4)]
+    drain(node)
+    from arbius_tpu.node.pipeline import STAGE_RANK
+
+    for tid in tids:
+        evs = node.obs.journal.events(kind="pipeline_stage", taskid=tid)
+        stages = [e["stage"] for e in evs]
+        assert stages == ["solve", "encode", "pin", "commit", "reveal"]
+        ranks = [STAGE_RANK[s] for s in stages]
+        assert ranks == sorted(ranks)
+    node.close()
+
+
+def test_pipeline_metrics_registered_and_moving():
+    eng, node, mid, _ = _world(_SD15FakeRunner(), canonical_batch=2,
+                               pipeline=PIPE_ON)
+    for i in range(4):
+        submit(eng, mid, prompt=f"t{i}")
+    drain(node)
+    reg = node.obs.registry
+    h = reg.histogram("arbius_pipeline_stage_seconds",
+                      labelnames=("stage",))
+    assert h.count(stage="device") >= 2
+    assert h.count(stage="encode") >= 2
+    assert h.count(stage="network") == 4
+    # the profitability gate's infer signal stays live in pipeline mode
+    # at the SERIAL path's granularity: one sample per bucket, so the
+    # p50 cost estimate reads the same whichever schedule runs
+    assert len(node.metrics.stage_seconds["infer"]) == 1
+    assert reg.counter("arbius_chip_idle_seconds_total").value() >= 0.0
+    node.close()
+
+
+# -- db write batching (one tick = one fsync) -------------------------------
+
+def test_tick_batches_sqlite_commits_to_one():
+    """A tick's claim/delete cycle used to fsync per mutation; under
+    NodeDB.batch() the whole tick is ONE commit, and the obs counter +
+    histogram record the win."""
+    eng, node, mid, _ = _world(_SD15FakeRunner(), canonical_batch=1)
+    reg = node.obs.registry
+    for i in range(4):
+        submit(eng, mid, prompt=f"t{i}")
+    c = reg.counter("arbius_db_commits_total")
+    h = reg.histogram("arbius_db_commit_seconds")
+    before, hbefore = c.value(), h.count()
+    done = node.tick()   # 4 task jobs: store input + queue solve + delete
+    assert done == 4
+    assert c.value() - before == 1, "a tick must be exactly one fsync"
+    assert h.count() - hbefore == 1
+    node.close()
+
+
+# -- failure isolation ------------------------------------------------------
+
+def test_chunk_failure_quarantines_only_that_chunk():
+    class FlakyRunner(_SD15FakeRunner):
+        def dispatch(self, items):
+            if any(h["prompt"] == "boom" for h, _ in items):
+                raise RuntimeError("chunk exploded")
+            return super().dispatch(items)
+
+    eng, node, mid, _ = _world(FlakyRunner(), canonical_batch=1,
+                               pipeline=PIPE_ON)
+    good = [submit(eng, mid, prompt=f"ok {i}") for i in range(2)]
+    bad = submit(eng, mid, prompt="boom")
+    drain(node)
+    for tid in good:
+        assert bytes.fromhex(tid[2:]) in eng.solutions
+    assert bytes.fromhex(bad[2:]) not in eng.solutions
+    assert ("solve", {"taskid": bad, "model": mid}) in [
+        (m, d) for m, d in node.db.failed_jobs()]
+    node.close()
+
+
+def test_kill_class_death_in_encode_worker_surfaces_as_failure():
+    """A BaseException inside a worker's finalize must not silently
+    kill the thread before it posts a result — that would wedge the
+    tick thread in cv.wait forever. It surfaces as a quarantined chunk
+    instead."""
+    class DyingRunner(_SD15FakeRunner):
+        def finalize(self, dev, n_real):
+            raise KeyboardInterrupt("worker killed")
+
+    eng, node, mid, _ = _world(DyingRunner(), canonical_batch=2,
+                               pipeline=PIPE_ON)
+    tids = [submit(eng, mid, prompt=f"t{i}") for i in range(2)]
+    drain(node)   # must return, not hang
+    failed = {d.get("taskid") for m, d in node.db.failed_jobs()
+              if m == "solve"}
+    assert failed == set(tids)
+    node.close()
+
+
+# -- checkpoint resume ------------------------------------------------------
+
+class _CountingPinner(_RecordingPinner):
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+
+    def pin_files(self, files, taskid=""):
+        self.calls += 1
+        return super().pin_files(files, taskid=taskid)
+
+
+def _crash_world(tmp_path):
+    """Shared fixture for the two crash flavors: a durable-checkpoint
+    world builder plus a kill planted inside signal_commitment."""
+    db_path = str(tmp_path / "node.sqlite")
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=10_000)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    for a in (MINER, USER):
+        tok.mint(a, 1_000 * WAD)
+        tok.approve(a, Engine.ADDRESS, 10**30)
+    mid = "0x" + eng.register_model(USER, MODEL_ADDR, 0, b"{}").hex()
+    registry = ModelRegistry()
+    registry.register(RegisteredModel(
+        id=mid, template=load_template("anythingv3"),
+        runner=_SD15FakeRunner()))
+
+    def spawn(pinner):
+        chain = LocalChain(eng, MINER)
+        cfg = MiningConfig(db_path=db_path,
+                           models=(ModelConfig(id=mid,
+                                               template="anythingv3"),),
+                           pipeline=PIPE_ON)
+        node = MinerNode(chain, cfg, registry, pinner=pinner)
+        node.boot()
+        return node
+
+    chain0 = LocalChain(eng, MINER)
+    chain0.validator_deposit(100 * WAD)
+    return eng, mid, spawn
+
+
+def test_pipeline_resumes_pin_recorded_by_a_flushed_window(tmp_path):
+    """A pin the checkpoint DURABLY recorded before a crash is not
+    re-run by the next life. The tick's batch window is made durable
+    the way it happens in production: a foreign (ControlRPC-class)
+    thread writes mid-tick, which fsyncs the window so far."""
+    import threading
+
+    eng, mid, spawn = _crash_world(tmp_path)
+    p1 = _CountingPinner()
+    node = spawn(p1)
+    drain(node)
+    tid = submit(eng, mid)
+
+    def flush_then_die(_commitment):
+        t = threading.Thread(target=lambda: node.db.queue_job(
+            "voteFinish", {"taskid": "0xflush"}, waituntil=2**50))
+        t.start()
+        t.join()
+        raise KeyboardInterrupt("sim kill")
+
+    node.chain.signal_commitment = flush_then_die
+    with pytest.raises(KeyboardInterrupt):
+        drain(node)
+    assert p1.calls == 1
+    state = node.db.get_pipeline_stage(tid)
+    assert state is not None and state[0] == "pin"
+    assert state[1] == cid_hex(cid_of_solution_files(p1.pinned[tid]))
+    node.close()
+
+    # reboot from the same checkpoint: solve re-runs, pin is skipped
+    p2 = _CountingPinner()
+    node2 = spawn(p2)
+    drain(node2)
+    assert p2.calls == 0, "restart re-ran a pin the checkpoint recorded"
+    assert bytes.fromhex(tid[2:]) in eng.solutions
+    resumed = [e for e in node2.obs.journal.events(kind="pipeline_stage",
+                                                   taskid=tid)
+               if e.get("resumed")]
+    assert [e["stage"] for e in resumed] == ["pin"]
+    # stage row cleared once the task completed
+    assert node2.db.get_pipeline_stage(tid) is None
+    node2.close()
+
+
+def test_pipeline_lost_batch_window_still_converges(tmp_path):
+    """kill -9 semantics: a BaseException unwinding the tick loses the
+    whole deferred sqlite window (batch() deliberately does NOT commit
+    on the process-death class), so the rebooted node finds NO
+    pipeline_state row — it must redo the pin and still converge to the
+    same CID with a single commitment."""
+    eng, mid, spawn = _crash_world(tmp_path)
+    p1 = _CountingPinner()
+    node = spawn(p1)
+    drain(node)
+    tid = submit(eng, mid)
+    node.chain.signal_commitment = lambda c: (_ for _ in ()).throw(
+        KeyboardInterrupt("sim kill"))
+    with pytest.raises(KeyboardInterrupt):
+        drain(node)
+    assert p1.calls == 1
+    # the window died with the process: nothing was checkpointed
+    assert node.db.get_pipeline_stage(tid) is None
+    node.close()
+
+    p2 = _CountingPinner()
+    node2 = spawn(p2)
+    drain(node2)
+    assert p2.calls == 1, "lost window must be re-derived, incl. the pin"
+    sol = eng.solutions[bytes.fromhex(tid[2:])]
+    assert "0x" + sol.cid.hex() == cid_hex(
+        cid_of_solution_files(p2.pinned[tid]))
+    assert p2.pinned[tid] == p1.pinned[tid], "re-derived bytes drifted"
+    node2.close()
+
+
+# -- config surface ---------------------------------------------------------
+
+def test_pipeline_config_loads_and_validates():
+    cfg = load_config({"pipeline": {"enabled": True, "depth": 3,
+                                    "encode_workers": 2,
+                                    "max_inflight_pins": 8}})
+    assert cfg.pipeline.enabled and cfg.pipeline.depth == 3
+    assert not load_config({}).pipeline.enabled  # default: synchronous
+    with pytest.raises(ConfigError, match="depth"):
+        load_config({"pipeline": {"depth": 0}})
+    with pytest.raises(ConfigError, match="encode_workers"):
+        load_config({"pipeline": {"encode_workers": -1}})
+    with pytest.raises(ConfigError, match="max_inflight_pins"):
+        load_config({"pipeline": {"max_inflight_pins": 0}})
+
+
+# -- simnet: crash mid-pipeline ---------------------------------------------
+
+def test_simnet_crash_mid_pipeline_loses_nothing(tmp_path):
+    """Kill the node after its 2nd commit lands (mid-pipeline, with the
+    staged executor active), reboot from the checkpoint: every task
+    claimed, no double-commit, SIM101-109 all green."""
+    from arbius_tpu.sim.harness import run_scenario
+    from arbius_tpu.sim.invariants import check_all, classify_tasks
+    from arbius_tpu.sim.scenario import get_scenario
+
+    result = run_scenario(get_scenario("crash-restart"), 3,
+                          db_path=str(tmp_path / "crash.sqlite"))
+    assert result.pipeline_enabled
+    findings = check_all(result)
+    assert not findings, "\n".join(f.text() for f in findings)
+    assert result.restarts == 1
+    assert set(classify_tasks(result).values()) == {"claimed"}
+    # no (validator, task) pair ever committed two different CIDs
+    per_task: dict[str, set] = {}
+    for sender, tid, cid in result.plane.commitments.values():
+        if sender == result.miner_address:
+            per_task.setdefault(tid, set()).add(cid)
+    assert per_task and all(len(c) == 1 for c in per_task.values())

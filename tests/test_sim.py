@@ -27,7 +27,11 @@ SEEDS = (1, 2)
 @pytest.fixture(scope="module")
 def matrix(tmp_path_factory):
     """(scenario, seed) → (harness, result, findings) for the whole
-    acceptance matrix — run once, audited by every test below."""
+    acceptance matrix — run once, audited by every test below. The
+    seeded runs drive the staged solve pipeline (the harness default);
+    one extra `(name, "sync")` run per scenario drives the SHIPPED
+    default (pipeline.enabled=false) through the same fault plane so
+    the synchronous _solve_bucket path never rots uncovered."""
     base = tmp_path_factory.mktemp("simnet")
     out = {}
     for name in TIER1_MATRIX:
@@ -36,6 +40,11 @@ def matrix(tmp_path_factory):
                            db_path=str(base / f"{name}-{seed}.sqlite"))
             result = h.run()
             out[(name, seed)] = (h, result, check_all(result))
+        h = SimHarness(get_scenario(name), SEEDS[0],
+                       db_path=str(base / f"{name}-sync.sqlite"),
+                       pipeline=False)
+        result = h.run()
+        out[(name, "sync")] = (h, result, check_all(result))
     return out
 
 
@@ -53,6 +62,34 @@ def test_scenario_matrix_holds_every_invariant(matrix, name, seed):
     # every task accounted: exactly one terminal label each
     labels = classify_tasks(result)
     assert set(labels) == set(result.tasks)
+
+
+@pytest.mark.parametrize("name", TIER1_MATRIX)
+def test_sync_default_path_holds_every_invariant(matrix, name):
+    """The shipped default (pipeline off, the synchronous solve path)
+    passes the same scenario catalog; SIM109 self-disables because no
+    staged executor ran."""
+    _, result, findings = matrix[(name, "sync")]
+    assert not result.pipeline_enabled
+    assert not findings, (
+        "invariant violations (pipeline OFF):\n  "
+        + "\n  ".join(f.text() for f in findings))
+    assert result.quiescent
+    # the sync path journals no stage events — SIM109's degenerate
+    # guard must not misfire on it
+    assert not [e for e in result.journal_events
+                if e.get("kind") == "pipeline_stage"]
+
+
+def test_pipeline_and_sync_reach_identical_cids(matrix):
+    """Same scenario, same seed, both schedules: every task's accepted
+    solution CID is identical — the pipeline changed the schedule, not
+    the bytes (the simnet version of the golden byte-equality gate)."""
+    _, piped, _ = matrix[("clean", SEEDS[0])]
+    _, sync, _ = matrix[("clean", "sync")]
+    cids = lambda r: {"0x" + t.hex(): "0x" + s.cid.hex()
+                      for t, s in r.engine.solutions.items()}
+    assert cids(piped) == cids(sync) and cids(piped)
 
 
 def test_clean_scenario_claims_everything(matrix):
