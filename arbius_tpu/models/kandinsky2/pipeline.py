@@ -99,6 +99,7 @@ class Kandinsky2Pipeline:
         self.decoder = DecoderUNet(self.config.decoder)
         self.movq = MOVQDecoder(self.config.movq)
         self._buckets: dict[tuple, object] = {}
+        self._coll_est: dict[tuple, dict] = {}  # per-bucket traffic estimate
 
     # -- params ----------------------------------------------------------
     def init_params(self, seed: int = 0, height: int = 64, width: int = 64,
@@ -142,12 +143,13 @@ class Kandinsky2Pipeline:
                             tp_rules if tp_rules is not None else DEFAULT_TP_RULES)
 
     def _place_batch(self, *arrays):
+        # meshsolve.shard_batch: dp when the batch divides, else
+        # replicated (under-filled buckets idle dp lanes, never error)
         if self.mesh is None:
             return arrays
-        from arbius_tpu.parallel import batch_sharding
+        from arbius_tpu.parallel import meshsolve
 
-        return tuple(jax.device_put(a, batch_sharding(self.mesh, a.ndim))
-                     for a in arrays)
+        return meshsolve.shard_batch(self.mesh, *arrays)
 
     # -- compiled bucket -------------------------------------------------
     def compiled_bucket(self, batch: int, height: int, width: int,
@@ -215,7 +217,19 @@ class Kandinsky2Pipeline:
             pixels = self.movq.apply({"params": params["movq"]}, x)
             return decode_to_images(pixels)
 
-        fn = jax.jit(run)
+        if self.mesh is None:
+            # the exact pre-mesh program: goldens pin this byte-for-byte
+            fn = jax.jit(run)
+        else:
+            # GSPMD batch/output specs; params inherit their boot-time
+            # rule-table placement (docs/multichip.md)
+            from arbius_tpu.parallel import meshsolve
+
+            spec, _ = meshsolve.batch_specs(self.mesh, batch)
+            fn = jax.jit(run,
+                         in_shardings=(None, spec(2), spec(1), spec(1),
+                                       spec(1)),
+                         out_shardings=spec(4))
         self._buckets[key] = fn
         return fn
 
@@ -236,9 +250,6 @@ class Kandinsky2Pipeline:
             raise ValueError(f"height/width must be multiples of {granule}")
         g = list(guidance_scale) if isinstance(guidance_scale, (list, tuple)) \
             else [guidance_scale] * batch
-        if self.mesh is not None and batch % self.mesh.shape["dp"]:
-            raise ValueError(
-                f"batch {batch} not divisible by dp={self.mesh.shape['dp']}")
         fn = self.compiled_bucket(batch, height, width, num_inference_steps,
                                   scheduler)
         ids = self.tokenizer.encode_batch(prompts)
@@ -255,6 +266,13 @@ class Kandinsky2Pipeline:
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
         images = fn(params, *args)
+        if self.mesh is not None:
+            from arbius_tpu.parallel import meshsolve
+
+            meshsolve.record_bucket_estimate(
+                self._coll_est,
+                (batch, height, width, num_inference_steps, scheduler),
+                self.mesh, images, batch, params=params)
         if as_device:
             # async-dispatch handle: the solver's chunk pipeline encodes
             # the previous chunk while the chip crunches this one
@@ -262,24 +280,47 @@ class Kandinsky2Pipeline:
         return np.asarray(images)
 
 
+# mesh layouts this family ships (docs/multichip.md): same table as
+# SD-1.5 — dp-only is bit-identical to mesh-off, dp×tp (DEFAULT_TP_RULES
+# over the decoder/prior attention + FF kernels) is its own determinism
+# class. One graphlint golden per layout below.
+MESH_LAYOUTS: tuple[tuple[str, ...], ...] = (("dp",), ("dp", "tp"))
+
+
 def trace_specs():
-    """graphlint trace spec (models/trace_specs.py): the whole
+    """graphlint trace specs (models/trace_specs.py): the whole
     text→prior→decoder→MOVQ bucket program — one jitted graph, so one
-    fingerprint covers both published sub-pipelines."""
+    fingerprint covers both published sub-pipelines — single-device and
+    under each shipped mesh layout (MESH_LAYOUTS, traced over
+    `parallel.abstract_mesh` so no devices are involved)."""
     from arbius_tpu.models.trace_specs import TraceSpec
+    from arbius_tpu.parallel import meshsolve
     from arbius_tpu.schedulers import sampler_tag
 
-    def build():
-        p = Kandinsky2Pipeline(Kandinsky2Config.tiny())
-        shapes = jax.eval_shape(
-            lambda: p.init_params(height=64, width=64))
-        sds = jax.ShapeDtypeStruct
-        length = p.config.text.max_length
-        args = (shapes, sds((1, length), jnp.int32),
-                sds((1,), jnp.float32),
-                sds((1,), jnp.uint32), sds((1,), jnp.uint32))
-        return p.compiled_bucket(1, 64, 64, 2, "DDIM"), args
+    def build_bucket(axes=()):
+        def build():
+            p = Kandinsky2Pipeline(Kandinsky2Config.tiny(),
+                                   mesh=meshsolve.golden_mesh(axes))
+            batch = 2 if axes else 1
+            shapes = jax.eval_shape(
+                lambda: p.init_params(height=64, width=64))
+            sds = jax.ShapeDtypeStruct
+            length = p.config.text.max_length
+            args = (shapes, sds((batch, length), jnp.int32),
+                    sds((batch,), jnp.float32),
+                    sds((batch,), jnp.uint32), sds((batch,), jnp.uint32))
+            return p.compiled_bucket(batch, 64, 64, 2, "DDIM"), args
 
-    return [TraceSpec(model="kandinsky2", entry="txt2img",
-                      bucket=f"b1.64x64.{sampler_tag('DDIM', 2)}",
-                      mesh="single", dtype="bfloat16", build=build)]
+        return build
+
+    return [
+        TraceSpec(model="kandinsky2", entry="txt2img",
+                  bucket=f"b1.64x64.{sampler_tag('DDIM', 2)}",
+                  mesh="single", dtype="bfloat16", build=build_bucket()),
+    ] + [
+        TraceSpec(model="kandinsky2", entry="txt2img",
+                  bucket=f"b2.64x64.{sampler_tag('DDIM', 2)}",
+                  mesh=meshsolve.golden_layout_tag(axes), dtype="bfloat16",
+                  build=build_bucket(axes))
+        for axes in MESH_LAYOUTS
+    ]

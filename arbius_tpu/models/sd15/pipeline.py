@@ -72,6 +72,7 @@ class SD15Pipeline:
         # per-instance executable cache: dies with the pipeline (an lru_cache
         # on the method would pin self in a class-global cache)
         self._buckets: dict[tuple, object] = {}
+        self._coll_est: dict[tuple, dict] = {}  # per-bucket traffic estimate
 
     # -- params ----------------------------------------------------------
     def init_params(self, seed: int = 0, height: int = 64, width: int = 64,
@@ -146,14 +147,15 @@ class SD15Pipeline:
         return shard_params(params, self.mesh, tp_rules)
 
     def _place_batch(self, *arrays):
-        """Shard batch-leading arrays over the dp axis of the mesh."""
+        """Shard batch-leading arrays over the dp axis of the mesh
+        (meshsolve.shard_batch: replicates instead when the batch does
+        not divide dp, so an under-filled bucket runs with idle dp lanes
+        rather than erroring)."""
         if self.mesh is None:
             return arrays
-        from arbius_tpu.parallel import batch_sharding
+        from arbius_tpu.parallel import meshsolve
 
-        return tuple(
-            jax.device_put(a, batch_sharding(self.mesh, a.ndim))
-            for a in arrays)
+        return meshsolve.shard_batch(self.mesh, *arrays)
 
     # -- compiled bucket -------------------------------------------------
     def _bucket_fn(self, batch: int, height: int, width: int,
@@ -198,7 +200,23 @@ class SD15Pipeline:
             pixels = self.vae.apply({"params": params["vae"]}, x / SD_LATENT_SCALE)
             return decode_to_images(pixels)
 
-        fn = jax.jit(run)
+        if self.mesh is None:
+            # the exact pre-mesh program: goldens pin this byte-for-byte
+            fn = jax.jit(run)
+        else:
+            # GSPMD (docs/multichip.md): batch args dp-sharded, params
+            # inherit their boot-time rule-table placement (None =
+            # unspecified), output left dp-sharded — the gather happens
+            # host-side in canonical order. XLA inserts the tp
+            # collectives from the param shardings.
+            from arbius_tpu.parallel import meshsolve
+
+            spec, _ = meshsolve.batch_specs(self.mesh, batch)
+            fn = jax.jit(
+                run,
+                in_shardings=(None, spec(2), spec(2), spec(1), spec(1),
+                              spec(1)),
+                out_shardings=spec(4))
         self._buckets[key] = fn
         return fn
 
@@ -253,9 +271,6 @@ class SD15Pipeline:
                 f"tokenizer produced id >= vocab_size ({vocab}); "
                 "tokenizer and text-encoder config are mismatched")
         seeds_arr = np.asarray(seeds, dtype=np.uint64)
-        if self.mesh is not None and batch % self.mesh.shape["dp"]:
-            raise ValueError(
-                f"batch {batch} not divisible by dp={self.mesh.shape['dp']}")
         args = self._place_batch(
             jnp.asarray(ids_c),
             jnp.asarray(ids_u),
@@ -264,22 +279,40 @@ class SD15Pipeline:
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
         images = fn(params, *args)
+        if self.mesh is not None:
+            from arbius_tpu.parallel import meshsolve
+
+            meshsolve.record_bucket_estimate(
+                self._coll_est,
+                (batch, height, width, num_inference_steps, scheduler),
+                self.mesh, images, batch, params=params)
         if as_device:
             return images
         return np.asarray(images)
+
+
+# mesh layouts this family ships (docs/multichip.md): dp-only scales
+# tasks bit-identically; dp×tp splits attention/MLP kernels via
+# DEFAULT_TP_RULES and is its own determinism class. Each layout gets
+# its own graphlint golden below — layout is data, like the rule table.
+MESH_LAYOUTS: tuple[tuple[str, ...], ...] = (("dp",), ("dp", "tp"))
 
 
 def trace_specs():
     """graphlint trace specs (models/trace_specs.py): the anythingv3
     bucket program at tiny topology, in both compute dtypes and under
     the two scheduler shapes (plain + ancestral-noise), all abstract —
-    params via eval_shape, no weights, CPU-traceable in seconds."""
+    params via eval_shape, no weights, CPU-traceable in seconds. Each
+    shipped mesh layout (MESH_LAYOUTS) traces over
+    `parallel.abstract_mesh`, so the GSPMD sharding annotations land in
+    the per-layout fingerprint with no physical devices involved."""
     import dataclasses
 
     from arbius_tpu.models.trace_specs import TraceSpec
+    from arbius_tpu.parallel import meshsolve
     from arbius_tpu.schedulers import sampler_tag
 
-    def build_bucket(dtype: str, steps: int, scheduler: str):
+    def build_bucket(dtype: str, steps: int, scheduler: str, axes=()):
         def build():
             cfg = SD15Config.tiny()
             if dtype != "bfloat16":
@@ -287,17 +320,19 @@ def trace_specs():
                     unet=dataclasses.replace(cfg.unet, dtype=dtype),
                     vae=dataclasses.replace(cfg.vae, dtype=dtype),
                     text=dataclasses.replace(cfg.text, dtype=dtype))
-            p = SD15Pipeline(cfg)
+            p = SD15Pipeline(cfg, mesh=meshsolve.golden_mesh(axes))
+            batch = 2 if axes else 1
             lh = 64 // p.VAE_FACTOR
             shapes = jax.eval_shape(p._init_fn(lh, lh),
                                     jax.random.PRNGKey(0))
             sds = jax.ShapeDtypeStruct
             length = cfg.text.max_length
             args = (shapes,
-                    sds((1, length), jnp.int32), sds((1, length), jnp.int32),
-                    sds((1,), jnp.float32),
-                    sds((1,), jnp.uint32), sds((1,), jnp.uint32))
-            return p.compiled_bucket(1, 64, 64, steps, scheduler), args
+                    sds((batch, length), jnp.int32),
+                    sds((batch, length), jnp.int32),
+                    sds((batch,), jnp.float32),
+                    sds((batch,), jnp.uint32), sds((batch,), jnp.uint32))
+            return p.compiled_bucket(batch, 64, 64, steps, scheduler), args
 
         return build
 
@@ -312,4 +347,10 @@ def trace_specs():
                   bucket=f"b1.64x64.{sampler_tag('K_EULER_ANCESTRAL', 2)}",
                   mesh="single", dtype="bfloat16",
                   build=build_bucket("bfloat16", 2, "K_EULER_ANCESTRAL")),
+    ] + [
+        TraceSpec(model="anythingv3", entry="txt2img",
+                  bucket=f"b2.64x64.{sampler_tag('DDIM', 2)}",
+                  mesh=meshsolve.golden_layout_tag(axes), dtype="bfloat16",
+                  build=build_bucket("bfloat16", 2, "DDIM", axes))
+        for axes in MESH_LAYOUTS
     ]

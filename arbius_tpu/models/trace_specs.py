@@ -99,9 +99,10 @@ def all_trace_specs() -> list[TraceSpec]:
     from arbius_tpu.models.rvm import pipeline as rvm_pipeline
     from arbius_tpu.models.sd15 import pipeline as sd15_pipeline
     from arbius_tpu.models.video import pipeline as video_pipeline
+    from arbius_tpu.parallel import meshsolve
 
     specs: list[TraceSpec] = []
     for mod in (sd15_pipeline, kandinsky2_pipeline, rvm_pipeline,
-                video_pipeline):
+                video_pipeline, meshsolve):
         specs.extend(mod.trace_specs())
     return validate_specs(specs)

@@ -80,6 +80,7 @@ class Text2VideoPipeline:
         self.unet = UNet3DCondition(self.config.unet)
         self.vae = VAEDecoder(self.config.vae)
         self._buckets: dict[tuple, object] = {}
+        self._coll_est: dict[tuple, dict] = {}  # per-bucket traffic estimate
 
     # -- params ----------------------------------------------------------
     def init_params(self, seed: int = 0, frames: int = 2, height: int = 64,
@@ -208,7 +209,8 @@ class Text2VideoPipeline:
                  num_frames: int = 16, width: int = 256, height: int = 256,
                  fps: int = 8, num_inference_steps: int = 20,
                  guidance_scale: float | list[float] = 9.0,
-                 scheduler: str = "DDIM") -> np.ndarray:
+                 scheduler: str = "DDIM",
+                 as_device: bool = False) -> np.ndarray:
         del fps  # container metadata, applied by the mp4 muxer
         batch = len(prompts)
         negs = negative_prompts or [""] * batch
@@ -235,19 +237,45 @@ class Text2VideoPipeline:
                  jnp.asarray(g, jnp.float32),
                  jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
                  jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32))
+        if self.mesh is not None:
+            from arbius_tpu.parallel import meshsolve
+
+            # params ride the shard_map replicated (in_spec P()), so the
+            # traffic model is the dp/sp output-gather + halo terms only
+            meshsolve.record_bucket_estimate(
+                self._coll_est,
+                (batch, num_frames, height, width, num_inference_steps,
+                 scheduler),
+                self.mesh, out, batch)
+        if as_device:
+            # async-dispatch handle: the video runner's chunk pipeline
+            # muxes the previous chunk while the chip crunches this one
+            return out
         return np.asarray(out)
+
+
+# mesh layouts this family ships (docs/multichip.md): the video path
+# runs the whole denoise scan under shard_map — dp shards samples, sp
+# shards frames (ring/ulysses temporal attention, ops/), tp rides the
+# rule table. Unlike the image families there is no dp-only entry: the
+# sp collectives are the reason this family meshes at all.
+MESH_LAYOUTS: tuple[tuple[str, ...], ...] = (("dp", "sp", "tp"),)
+# the shard_map hard-partitions the batch axis over dp — an indivisible
+# canonical_batch is a boot error, not a replicate-degrade
+# (meshsolve.check_mesh_contract reads this, like MESH_LAYOUTS, as data)
+MESH_BATCH_HARD = True
 
 
 def trace_specs():
     """graphlint trace specs (models/trace_specs.py): the UNet3D video
-    bucket single-device AND under a dp×sp×tp shard_map layout. The
-    mesh variant traces over `parallel.abstract_mesh`, so the ring
-    attention / halo exchange collectives land in the fingerprint with
-    no physical devices (and no device ids) involved — mesh layout is
-    part of the determinism class (docs/determinism.md) and therefore
-    part of the golden key."""
+    bucket single-device AND under each shipped shard_map layout
+    (MESH_LAYOUTS). The mesh variant traces over
+    `parallel.abstract_mesh`, so the ring attention / halo exchange
+    collectives land in the fingerprint with no physical devices (and
+    no device ids) involved — mesh layout is part of the determinism
+    class (docs/determinism.md) and therefore part of the golden key."""
     from arbius_tpu.models.trace_specs import TraceSpec
-    from arbius_tpu.parallel import MeshSpec, abstract_mesh, mesh_tag
+    from arbius_tpu.parallel import meshsolve
     from arbius_tpu.schedulers import sampler_tag
 
     def build_single():
@@ -255,9 +283,8 @@ def trace_specs():
         return _bucket_args(p, batch=1)
 
     def build_sharded():
-        mesh = abstract_mesh(MeshSpec(dp=2, sp=2, tp=2))
         p = Text2VideoPipeline(Text2VideoConfig.tiny(sp_axis="sp"),
-                               mesh=mesh)
+                               mesh=meshsolve.golden_mesh(MESH_LAYOUTS[0]))
         return _bucket_args(p, batch=2)
 
     def _bucket_args(p, batch):
@@ -273,7 +300,7 @@ def trace_specs():
         return p.compiled_bucket(batch, 2, 64, 64, 2, "DDIM"), args
 
     bucket = f"f2.64x64.{sampler_tag('DDIM', 2)}"
-    sharded_tag = mesh_tag(abstract_mesh(MeshSpec(dp=2, sp=2, tp=2)))
+    sharded_tag = meshsolve.golden_layout_tag(MESH_LAYOUTS[0])
     return [
         TraceSpec(model="zeroscopev2xl", entry="txt2vid",
                   bucket=f"b1.{bucket}", mesh="single", dtype="bfloat16",

@@ -158,6 +158,16 @@ class MiningConfig:
     # dp batch per solve dispatch; MUST be fleet-wide per model class
     # (batch size is part of the XLA program = the determinism class)
     canonical_batch: int = 1
+    # device-mesh layout for the solve path (docs/multichip.md), e.g.
+    # {"dp": 4, "tp": 2} or {"dp": 2, "sp": 2, "tp": 2}; null/absent =
+    # the exact single-device path. dp shards the bucket batch
+    # (bit-identical to mesh-off — test-pinned); tp/sp layouts are each
+    # their OWN determinism class, pinned per (family, layout) by the
+    # graphlint goldens, so a fleet mines one layout per model — the
+    # same fleet-wide rule as canonical_batch. Axis names/values are
+    # validated here; the device-count fit is checked at boot where jax
+    # is up (parallel/meshsolve.boot_mesh).
+    mesh: dict | None = None
     profile_dir: str | None = None   # jax.profiler trace output dir
     profile_every: int = 0           # trace every Nth solve dispatch
     # obs subsystem (docs/observability.md): span tracing + event journal.
@@ -190,6 +200,17 @@ class MiningConfig:
     def __post_init__(self):
         import re as _re
 
+        if self.mesh is not None:
+            from arbius_tpu.parallel.mesh import validate_axes
+
+            if not isinstance(self.mesh, dict) or not self.mesh:
+                raise ConfigError(
+                    "mesh must be a non-empty {axis: size} object "
+                    '(e.g. {"dp": 4, "tp": 2}) or null')
+            try:
+                validate_axes(dict(self.mesh), None, where="mesh config")
+            except ValueError as e:
+                raise ConfigError(str(e)) from None
         if self.delegated_validator is not None and not _re.fullmatch(
                 r"0x[0-9a-fA-F]{40}", self.delegated_validator):
             raise ConfigError(

@@ -41,6 +41,7 @@ def _needs_cast(params, dtype) -> bool:
 
 def _params_for(pipe, m: ModelConfig):
     dtype = "bfloat16" if m.weights_dtype == "bfloat16" else None
+    mesh = getattr(pipe, "mesh", None)
     if m.checkpoint:
         from arbius_tpu.utils import load_params
 
@@ -61,19 +62,36 @@ def _params_for(pipe, m: ModelConfig):
             # 16 GB-chip OOM the random-init path fixes via with_cast)
             params = jax.jit(lambda p: cast_floating(p, dtype),
                              donate_argnums=0)(params)
-        else:
+        elif mesh is None:
             # loaded leaves are host numpy arrays; commit them to the
             # device ONCE here (the cast program used to do this as a
             # side effect) — otherwise every solve re-uploads the full
             # weight tree through the jitted bucket call
             params = jax.device_put(params)
+        if mesh is not None:
+            # shard ONCE at boot via the family's rule table (one batched
+            # device_put over the tree — docs/multichip.md): TP kernels
+            # by rule, everything else replicated across the mesh. The
+            # no-cast path shards STRAIGHT from the host tree — routing
+            # through a whole-tree device_put first would park the full
+            # unsharded tree on one chip (transient 2× residency at boot
+            # for nothing). The cast path above still lands on one
+            # device first; storing checkpoints in the pinned dtype (the
+            # documented config) avoids that hop entirely.
+            params = pipe.place_params(params)
         return params
     log.warning("model %s: no checkpoint configured, using random init",
                 m.id)
+    if mesh is not None and hasattr(pipe, "init_params_placed") \
+            and dtype is None:
+        # fused init + placement: one XLA program whose out_shardings
+        # are the rule table's, so the unsharded tree never exists
+        return pipe.init_params_placed(seed=0)
     # dtype folds the cast into the init program: a separate cast program
     # holds BOTH trees live (f32 + bf16 — 18 GB for the ~3B kandinsky
     # tree) and OOMs a 16 GB chip; fused, each f32 leaf dies at its cast
-    return pipe.init_params(seed=0, dtype=dtype)
+    params = pipe.init_params(seed=0, dtype=dtype)
+    return pipe.place_params(params) if mesh is not None else params
 
 
 def _tokenizer_for(m: ModelConfig, text_cfg):
@@ -203,6 +221,31 @@ _BUILDERS = {
     "damo": _video,
 }
 
+# template → the pipeline module publishing that family's mesh contract
+# as data (MESH_LAYOUTS, MESH_BATCH_HARD — docs/multichip.md). One row
+# per mesh-capable _BUILDERS entry; robust_video_matting is absent on
+# purpose (stateful ConvGRU frame stream, never meshed). This is THE
+# family list meshsolve.check_mesh_contract audits against — a new
+# template is mesh-blind until it gets a row here.
+_MESH_CONTRACT_MODULES = {
+    "anythingv3": "arbius_tpu.models.sd15.pipeline",
+    "kandinsky2": "arbius_tpu.models.kandinsky2.pipeline",
+    "zeroscopev2xl": "arbius_tpu.models.video.pipeline",
+    "damo": "arbius_tpu.models.video.pipeline",
+}
+
+
+def mesh_contracts(cfg: MiningConfig) -> dict:
+    """Enabled mesh-capable templates → their pipeline modules, the
+    contract table `meshsolve.check_mesh_contract` boot-audits (layout
+    ∈ MESH_LAYOUTS, canonical_batch % dp)."""
+    import importlib
+
+    return {m.template: importlib.import_module(
+                _MESH_CONTRACT_MODULES[m.template])
+            for m in cfg.models
+            if m.enabled and m.template in _MESH_CONTRACT_MODULES}
+
 
 def build_registry(cfg: MiningConfig, *, mesh=None,
                    resolve_file=None) -> ModelRegistry:
@@ -211,7 +254,20 @@ def build_registry(cfg: MiningConfig, *, mesh=None,
     `resolve_file` (cid → bytes) is required only for file-input
     templates (robust_video_matting); leave None to skip those with a
     warning rather than fail the whole node.
+
+    When `cfg.mesh` is set (and no explicit `mesh` is passed) the solve
+    mesh is built here — validated against the visible device count with
+    a boot-quality error — and every mesh-capable family's params are
+    sharded onto it once via its rule table (docs/multichip.md).
+    robust_video_matting stays single-device (stateful ConvGRU frame
+    stream); the mesh is simply not passed to it.
     """
+    if mesh is None and cfg.mesh is not None:
+        from arbius_tpu.parallel import meshsolve
+
+        mesh = meshsolve.boot_mesh(cfg.mesh)
+        meshsolve.check_mesh_contract(mesh, mesh_contracts(cfg),
+                                      cfg.canonical_batch)
     reg = ModelRegistry()
     for m in cfg.models:
         if not m.enabled:

@@ -143,6 +143,7 @@ class MinerNode:
             "device (the host+network tail the pipeline exists to hide)")
         self.metrics = NodeMetrics(self.obs)
         self._retry_sleep = lambda s: None  # injectable; chain time is fake
+        self.mesh = None          # built + validated at boot (cfg.mesh)
         self._pipeline = None
         if config.pipeline.enabled:
             from arbius_tpu.node.pipeline import SolvePipeline
@@ -162,6 +163,23 @@ class MinerNode:
             from arbius_tpu.utils import enable_compile_cache
 
             enable_compile_cache(self.config.compile_cache_dir)
+        # solve mesh (docs/multichip.md): built and VALIDATED here — a
+        # shape that doesn't fit jax.device_count() must die at boot
+        # with one clear sentence, not as a deep XLA reshape failure
+        # mid-mine. Also publishes arbius_mesh_devices and audits
+        # canonical_batch divisibility against dp. (build_registry
+        # builds its own mesh object for the runners; this one is the
+        # node's validation + obs anchor — both come from the same
+        # config, so they always agree.)
+        from arbius_tpu.parallel import meshsolve
+
+        self.mesh = meshsolve.boot_mesh(self.config.mesh,
+                                        registry=self.obs.registry)
+        from arbius_tpu.node.factory import mesh_contracts
+
+        meshsolve.check_mesh_contract(self.mesh,
+                                      mesh_contracts(self.config),
+                                      self.config.canonical_batch)
         self.db.clear_jobs_by_method("validatorStake")
         self.db.clear_jobs_by_method("automine")
         if self.chain.version() > MINER_VERSION:
@@ -433,6 +451,24 @@ class MinerNode:
                            error=f"{type(e).__name__}: {e}")
             return
         hydrated["seed"] = taskid2seed(taskid)
+        if self.mesh is not None:
+            # mesh-shape intake gate (docs/multichip.md): a video task
+            # whose num_frames does not divide sp cannot run on this
+            # layout (the shard_map hard-partitions frames) — skip it
+            # BEFORE queuing, instead of burning solve attempts on a
+            # doomed compile. NOT marked invalid: the task is protocol-
+            # valid and other layouts can mine it honestly.
+            sp = self.mesh.shape.get("sp", 1)
+            frames = hydrated.get("num_frames")
+            if sp > 1 and frames is not None and int(frames) % sp:
+                log.info("task %s num_frames=%s not divisible by mesh "
+                         "sp=%d — not mineable under this layout, "
+                         "skipping", taskid, frames, sp)
+                self.obs.registry.counter(
+                    "arbius_tasks_unmineable_total",
+                    "Tasks skipped because their shape cannot run on "
+                    "the configured mesh layout").inc()
+                return
         self.db.store_task_input(taskid, "", hydrated)
         if self.store is not None or self.pinner is not None:
             # pin the raw input so contestation evidence stays
@@ -458,9 +494,13 @@ class MinerNode:
         return fee >= int(est * rate)
 
     def _bucket_key(self, model_id: str, hydrated: dict) -> tuple:
+        # num_frames is part of the compiled program for video templates
+        # (image templates simply have None here) — without it a batched
+        # video dispatch could chunk tasks of different frame counts
+        # into one generate() call
         return (model_id, hydrated.get("width"), hydrated.get("height"),
                 hydrated.get("num_inference_steps"),
-                hydrated.get("scheduler"))
+                hydrated.get("scheduler"), hydrated.get("num_frames"))
 
     def _process_solve_batch(self, jobs: list[Job]) -> int:
         """Group solve jobs by shape bucket and run each bucket as ONE

@@ -30,6 +30,14 @@ matches skips the pin/commit work that already happened.
 Every stage buffer is bounded — CONC302 is enforced for this file: an
 unbounded queue would hide a slow consumer instead of exerting
 backpressure on the dispatcher.
+
+Mesh transparency (docs/multichip.md): the executor never looks inside
+a device payload, so sharded solves ride the same stages unchanged —
+`runner.dispatch` places the batch with its NamedShardings and queues
+the GSPMD program (still async, so depth-k prefetch overlaps exactly as
+on one chip), and `runner.finalize` performs the fully-replicated
+gather in canonical order before encoding. mesh=None and any mesh
+layout therefore share this schedule byte-for-byte.
 """
 # detlint: enforce[CONC302]
 from __future__ import annotations

@@ -203,8 +203,12 @@ class Kandinsky2Runner:
         )
 
     def finalize(self, images, n_real: int) -> list[dict]:
+        from arbius_tpu.parallel.meshsolve import gather_canonical
+
         with span("solve.encode", n=n_real, codec="png"):
-            images = np.asarray(images)
+            # fully-replicated gather in canonical order: sample i is
+            # task i on every mesh layout (meshsolve.gather_canonical)
+            images = gather_canonical(images)
             return [{self.out_name: encode_png(images[i])}
                     for i in range(n_real)]
 
@@ -227,28 +231,55 @@ class Text2VideoRunner:
                          "fps": 8, **(defaults or {})}
 
     def __call__(self, hydrated: dict, seed: int) -> dict:
+        return self.finalize(self.dispatch([(hydrated, seed)]), 1)[0]
+
+    def run_batch(self, items: list[tuple[dict, int]]) -> list[dict]:
+        """One dp×sp-batched dispatch for a whole shape bucket: the
+        node's bucket key includes num_frames (plus w/h/steps/scheduler),
+        so every item shares the compiled program; prompts, negatives,
+        seeds, guidance — and the container-only fps — vary per item."""
+        return self.finalize(self.dispatch(items), len(items))
+
+    def _get(self, hydrated: dict, key: str):
+        v = hydrated.get(key)
+        return v if v is not None else self.defaults[key]
+
+    def dispatch(self, items: list[tuple[dict, int]]):
+        """Queue the bucket's XLA dispatch and return WITHOUT waiting
+        (see SD15Runner.dispatch): the staged pipeline muxes chunk i's
+        MP4s while the chip crunches chunk i+1. fps is mp4-container
+        metadata, not part of the compiled program, so the per-item
+        values ride along to finalize instead of the bucket key."""
+        first = items[0][0]
+        g = lambda k: self._get(first, k)
+        frames = self.pipeline.generate(
+            self.params,
+            prompts=[h["prompt"] for h, _ in items],
+            negative_prompts=[h.get("negative_prompt", "") for h, _ in items],
+            seeds=[s for _, s in items],
+            num_frames=int(g("num_frames")),
+            width=int(g("width")), height=int(g("height")),
+            num_inference_steps=int(g("num_inference_steps")),
+            guidance_scale=[float(self._get(h, "guidance_scale"))
+                            for h, _ in items],
+            as_device=True,
+        )
+        return frames, [int(self._get(h, "fps")) for h, _ in items]
+
+    def finalize(self, dev, n_real: int) -> list[dict]:
         # H.264 (all-intra I_PCM, codecs/h264.py) — the artifact class
         # the reference's cog/ffmpeg outputs belong to, so the dapp's
         # <video> tag (website/src/pages/task/[taskid].tsx:214-224
         # analogue) can actually play it; MJPEG-MP4 was deterministic
         # but not browser-decodable (round-4 verdict, missing #1)
         from arbius_tpu.codecs import encode_mp4_h264
+        from arbius_tpu.parallel.meshsolve import gather_canonical
 
-        d = self.defaults
-        g = lambda k: hydrated.get(k) if hydrated.get(k) is not None else d[k]
-        frames = self.pipeline.generate(
-            self.params,
-            prompts=[hydrated["prompt"]],
-            negative_prompts=[hydrated.get("negative_prompt", "")],
-            seeds=[seed],
-            num_frames=int(g("num_frames")),
-            width=int(g("width")), height=int(g("height")),
-            num_inference_steps=int(g("num_inference_steps")),
-            guidance_scale=float(g("guidance_scale")),
-        )
-        with span("solve.encode", n=1, codec="h264"):
-            return {self.out_name: encode_mp4_h264(frames[0],
-                                                   fps=int(g("fps")))}
+        frames, fps = dev
+        with span("solve.encode", n=n_real, codec="h264"):
+            frames = gather_canonical(frames)
+            return [{self.out_name: encode_mp4_h264(frames[i], fps=fps[i])}
+                    for i in range(n_real)]
 
 
 class RVMRunner:
@@ -330,8 +361,12 @@ class SD15Runner:
     def finalize(self, images, n_real: int) -> list[dict]:
         """Device result → per-item encoded files (blocks on the
         transfer, then host-side codec). Bytes identical to the
-        unpipelined path: encode order and inputs are unchanged."""
+        unpipelined path: encode order and inputs are unchanged. On a
+        mesh the result arrives dp-sharded; gather_canonical is the
+        fully-replicated gather in canonical sample order."""
+        from arbius_tpu.parallel.meshsolve import gather_canonical
+
         with span("solve.encode", n=n_real, codec="png"):
-            images = np.asarray(images)
+            images = gather_canonical(images)
             return [{self.out_name: encode_png(images[i])}
                     for i in range(n_real)]

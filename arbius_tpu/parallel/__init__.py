@@ -24,6 +24,7 @@ from arbius_tpu.parallel.mesh import (
     build_mesh,
     local_mesh,
     mesh_tag,
+    validate_axes,
 )
 from arbius_tpu.parallel.sharding import (
     DEFAULT_TP_RULES,
@@ -40,6 +41,7 @@ from arbius_tpu.parallel.collectives import (
 )
 from arbius_tpu.parallel.distributed import initialize_distributed
 from arbius_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from arbius_tpu.parallel import meshsolve
 
 __all__ = [
     "DEFAULT_TP_RULES",
@@ -48,6 +50,8 @@ __all__ = [
     "build_mesh",
     "local_mesh",
     "mesh_tag",
+    "meshsolve",
+    "validate_axes",
     "batch_sharding",
     "replicated",
     "shard_params",

@@ -126,7 +126,8 @@ class SimHarness:
     def __init__(self, scenario: Scenario, seed: int,
                  db_path: str = ":memory:",
                  node_cls: type[MinerNode] = MinerNode,
-                 pipeline: bool = True):
+                 pipeline: bool = True,
+                 mesh: dict | None = None):
         if scenario.faults.crash_after_commit is not None \
                 and db_path == ":memory:":
             # a restart from :memory: builds an EMPTY NodeDB — the run
@@ -142,6 +143,20 @@ class SimHarness:
         self.db_path = db_path
         self.node_cls = node_cls
         self.pipeline = pipeline
+        # mesh scenarios (docs/multichip.md): a `mesh` config swaps the
+        # hash-fake FaultyRunner for meshsolve's ShardedImageProbe — a
+        # REAL jitted GSPMD program over the forced 8-way CPU devices,
+        # fault-gated per dispatch exactly where FaultyRunner gates. The
+        # probe's bytes are layout-invariant by construction, so a run
+        # at mesh={"dp":2} must produce the same CIDs as mesh=None
+        # (tests/test_meshsolve.py pins it); SIM101-109 audit unchanged.
+        # mesh={} means "probe runner, no mesh" — the equality baseline.
+        self.mesh_cfg = mesh
+        self.mesh = None
+        if mesh is not None and mesh:
+            from arbius_tpu.parallel import meshsolve
+
+            self.mesh = meshsolve.boot_mesh(dict(mesh))
 
         self.token = TokenLedger()
         self.engine = Engine(self.token, start_time=START_TIME)
@@ -220,12 +235,26 @@ class SimHarness:
             # runs both so neither schedule's path rots uncovered).
             pipeline=PipelineConfig(enabled=True, depth=2,
                                     encode_workers=2, max_inflight_pins=2)
-            if self.pipeline else PipelineConfig())
+            if self.pipeline else PipelineConfig(),
+            # canonical_batch 2 so a dp2 mesh actually shards the
+            # dispatch (batch 1 degrades to replicated — still correct,
+            # but then the scenario would not exercise the dp path);
+            # the mesh-off probe baseline runs the same batch so the
+            # chunking is identical and only the layout differs
+            mesh=dict(self.mesh_cfg) if self.mesh_cfg else None,
+            canonical_batch=2 if self.mesh_cfg is not None else 1)
         self.result.pipeline_enabled = self.pipeline
+        if self.mesh_cfg is not None:
+            from arbius_tpu.parallel.meshsolve import ShardedImageProbe
+
+            runner = ShardedImageProbe(mesh=self.mesh,
+                                       gate=self.plane.runner_gate)
+        else:
+            runner = FaultyRunner(self.plane)
         registry = ModelRegistry()
         registry.register(RegisteredModel(
             id=self.model_id, template=load_template("anythingv3"),
-            runner=FaultyRunner(self.plane)))
+            runner=runner))
         db = NodeDB(self.db_path)
         node = self.node_cls(chain, cfg, registry, db=db, store=None,
                              pinner=SimPinner(self.plane))
@@ -365,11 +394,16 @@ class SimHarness:
 def run_scenario(scenario: Scenario, seed: int, *,
                  db_path: str = ":memory:",
                  node_cls: type[MinerNode] = MinerNode,
-                 pipeline: bool = True) -> SimResult:
+                 pipeline: bool = True,
+                 mesh: dict | None = None) -> SimResult:
     """Build a world, drive the scenario to quiescence, return the
     auditable result. `node_cls` lets regression tests inject a
     deliberately buggy node (tests/test_sim.py double-commit);
     `pipeline=False` runs the shipped synchronous solve path instead of
-    the staged executor."""
+    the staged executor. `mesh` (e.g. ``{"dp": 2}``) runs the solves as
+    real sharded XLA programs on the virtual device mesh via the
+    meshsolve image probe; ``{}`` selects the probe with no mesh (the
+    CID-equality baseline for a meshed run)."""
     return SimHarness(scenario, seed, db_path=db_path,
-                      node_cls=node_cls, pipeline=pipeline).run()
+                      node_cls=node_cls, pipeline=pipeline,
+                      mesh=mesh).run()

@@ -377,14 +377,14 @@ def _timed_solutions(pipe, params, batch: int, *, width: int, height: int,
     return (time.perf_counter() - t0) / (rounds * batch)
 
 
-def _child_common(cpu: bool):
+def _child_common(cpu: bool, n_devices: int = 1):
     # env JAX_PLATFORMS=cpu is NOT enough here: the deployment's axon
     # register module monkeypatches get_backend and dials the remote-TPU
     # tunnel anyway; force_cpu_devices neuters the non-CPU factories.
     if cpu:
         from arbius_tpu.utils import force_cpu_devices
 
-        force_cpu_devices(1)
+        force_cpu_devices(n_devices)
     import jax
 
     from arbius_tpu.utils import enable_compile_cache
@@ -535,6 +535,121 @@ def _pipeline_ab(out_path: str, pipe, params, platform: str, hb) -> None:
         "modes": {"off": off, "on": on},
         "elapsed_s": round(time.perf_counter() - _T0, 1),
     })
+
+
+def _stage_mesh_ab(out_path: str) -> None:
+    """mesh_ab stage (docs/multichip.md): the REAL node tick loop solves
+    the same bucket at mesh-off, dp2, and dp2·tp2 over 8 forced CPU
+    devices — config → build_registry (boot_mesh + fused sharded init)
+    → MinerNode → staged pipeline — reporting sol/h, chip-idle seconds,
+    and per-stage p50/p95 from the obs registry per layout, plus the
+    determinism cross-check (off == dp2 CIDs bitwise; dp2·tp2 is its own
+    golden-pinned class). CPU sanity numbers only, no perf claim; the
+    result also lands in MULTICHIP_r06.json at the repo root."""
+    import json as _json
+
+    hb = _Heartbeat("mesh_ab")
+    devs = _child_common(cpu=True, n_devices=8)
+    platform = devs[0].platform
+
+    from arbius_tpu.chain import WAD, Engine, TokenLedger
+    from arbius_tpu.node import LocalChain, MinerNode, MiningConfig, ModelConfig
+    from arbius_tpu.node.config import PipelineConfig
+    from arbius_tpu.node.factory import build_registry
+
+    N, BATCH = 8, 2
+    raw = {"prompt": "mesh ab warmup", "negative_prompt": "",
+           "width": 128, "height": 128, "num_inference_steps": 2}
+
+    def run_mode(mesh_cfg, label: str) -> dict:
+        tok = TokenLedger()
+        eng = Engine(tok, start_time=10_000)
+        tok.mint(Engine.ADDRESS, 600_000 * WAD)
+        miner, user = "0x" + "aa" * 20, "0x" + "01" * 20
+        for a in (miner, user):
+            tok.mint(a, 1_000 * WAD)
+            tok.approve(a, Engine.ADDRESS, 10**30)
+        mid = "0x" + eng.register_model(user, user, 0, b"{}").hex()
+        cfg = MiningConfig(
+            models=(ModelConfig(id=mid, template="anythingv3", tiny=True),),
+            canonical_batch=BATCH, compile_cache_dir=None, mesh=mesh_cfg,
+            pipeline=PipelineConfig(enabled=True, depth=2,
+                                    encode_workers=2, max_inflight_pins=2))
+        hb.set(f"mesh_ab: {label} boot (registry + sharded init)")
+        registry = build_registry(cfg)
+        chain = LocalChain(eng, miner)
+        chain.validator_deposit(100 * WAD)
+        node = MinerNode(chain, cfg, registry)
+        node.boot(skip_self_test=True)
+        while node.tick():
+            pass
+        for i in range(N):
+            eng.submit_task(user, 0, user, bytes.fromhex(mid[2:]), 0,
+                            _json.dumps(dict(raw, prompt=f"mesh task {i}"),
+                                        sort_keys=True).encode())
+        hb.set(f"mesh_ab: {label} ({N} solves)")
+        t0 = time.perf_counter()
+        for _ in range(64):
+            if node.tick() == 0:
+                break
+        elapsed = time.perf_counter() - t0
+        assert len(eng.solutions) == N, f"{label}: {len(eng.solutions)}/{N}"
+        reg = node.obs.registry
+        h = reg.get("arbius_stage_seconds")  # node-registered buckets
+        stages = h.summary() if h is not None else {}
+        out = {
+            "mesh": mesh_cfg,
+            "mesh_devices": int(
+                reg.gauge("arbius_mesh_devices").value()),
+            "solutions": N,
+            "seconds": round(elapsed, 3),
+            "solutions_per_hour": round(3600.0 * N / elapsed, 2),
+            "chip_idle_seconds": round(
+                reg.counter("arbius_chip_idle_seconds_total").value(), 4),
+            "collective_bytes": reg.counter(
+                "arbius_collective_bytes_total",
+                labelnames=("axis",)).summary(),
+            "stage_seconds": stages,
+            "cids": {"0x" + t.hex(): "0x" + s.cid.hex()
+                     for t, s in eng.solutions.items()},
+        }
+        node.close()
+        return out
+
+    modes = {}
+    for label, mesh_cfg in (("off", None), ("dp2", {"dp": 2}),
+                            ("dp2tp2", {"dp": 2, "tp": 2})):
+        modes[label] = run_mode(mesh_cfg, label)
+    # determinism cross-check: dp shards samples — bitwise equal to off;
+    # dp·tp moves reduction order — its OWN class, must still be
+    # internally consistent (8 distinct tasks ⇒ 8 distinct CIDs)
+    assert sorted(modes["off"]["cids"].values()) == \
+        sorted(modes["dp2"]["cids"].values()), "dp2 broke byte equality"
+    assert len(set(modes["dp2tp2"]["cids"].values())) == N
+    line = {
+        "metric": "mesh_ab_tiny_solutions_per_hour",
+        "value": modes["dp2"]["solutions_per_hour"],
+        "unit": (f"solutions/hour (TINY 128x128x2 through the full node "
+                 f"tick loop, canonical_batch={BATCH}, platform="
+                 f"{platform}, 8 virtual devices — CPU A/B sanity, no "
+                 "perf claim)"),
+        "vs_baseline": 0.0,
+        "note": ("mesh_ab: solve mesh off vs dp2 vs dp2.tp2; off==dp2 "
+                 "bytes asserted, dp2.tp2 is its own determinism class "
+                 "(docs/multichip.md)"),
+        "stage": "mesh_ab",
+        "modes": {k: {kk: vv for kk, vv in v.items() if kk != "cids"}
+                  for k, v in modes.items()},
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+    }
+    _emit(out_path, line)
+    with open(os.path.join(_REPO, "MULTICHIP_r06.json"), "w") as f:
+        json.dump({"n_devices": 8, "ok": True, "stage": "mesh_ab",
+                   "platform": platform, "result": line}, f, indent=1)
+        f.write("\n")
+    _note("mesh_ab: wrote MULTICHIP_r06.json")
+    hb.stop()
+    os._exit(0)
 
 
 def _prod_line(val: float, unit: str, note: str, stage: str,
@@ -1004,7 +1119,7 @@ def _record_goldens(hb: _Heartbeat, left, only_missing: bool = False) -> None:
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--stage", choices=["tiny", "session"])
+    ap.add_argument("--stage", choices=["tiny", "session", "mesh_ab"])
     ap.add_argument("--out")
     ns = ap.parse_args()
     if ns.stage is not None and not ns.out:
@@ -1013,5 +1128,7 @@ if __name__ == "__main__":
         main()
     elif ns.stage == "tiny":
         _stage_tiny(ns.out)
+    elif ns.stage == "mesh_ab":
+        _stage_mesh_ab(ns.out)
     else:
         _stage_session(ns.out)
