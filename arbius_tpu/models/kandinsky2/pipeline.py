@@ -154,10 +154,23 @@ class Kandinsky2Pipeline:
     # -- compiled bucket -------------------------------------------------
     def compiled_bucket(self, batch: int, height: int, width: int,
                         steps: int, scheduler: str):
+        return self._get_bucket(batch, height, width, steps, scheduler)[0]
+
+    def _get_bucket(self, batch: int, height: int, width: int,
+                    steps: int, scheduler: str):
+        """(fn, warm, tag) — cache lookup reported through the
+        jit-cache metrics (docs/observability.md)."""
+        from arbius_tpu.obs import jit_cache_get
+
         key = (batch, height, width, steps, scheduler)
-        cached = self._buckets.get(key)
-        if cached is not None:
-            return cached
+        return jit_cache_get(
+            self._buckets, key,
+            lambda: self._build_bucket(batch, height, width, steps,
+                                       scheduler),
+            tag="kandinsky2." + ".".join(str(k) for k in key))
+
+    def _build_bucket(self, batch: int, height: int, width: int,
+                      steps: int, scheduler: str):
         cfg = self.config
         sampler = get_sampler(scheduler, steps)
         lh, lw = height // self.MOVQ_FACTOR, width // self.MOVQ_FACTOR
@@ -230,7 +243,6 @@ class Kandinsky2Pipeline:
                          in_shardings=(None, spec(2), spec(1), spec(1),
                                        spec(1)),
                          out_shardings=spec(4))
-        self._buckets[key] = fn
         return fn
 
     # -- public API ------------------------------------------------------
@@ -250,8 +262,8 @@ class Kandinsky2Pipeline:
             raise ValueError(f"height/width must be multiples of {granule}")
         g = list(guidance_scale) if isinstance(guidance_scale, (list, tuple)) \
             else [guidance_scale] * batch
-        fn = self.compiled_bucket(batch, height, width, num_inference_steps,
-                                  scheduler)
+        fn, warm, tag = self._get_bucket(batch, height, width,
+                                         num_inference_steps, scheduler)
         ids = self.tokenizer.encode_batch(prompts)
         vocab = self.config.text.vocab_size
         if int(ids.max()) >= vocab:
@@ -265,7 +277,10 @@ class Kandinsky2Pipeline:
             jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
-        images = fn(params, *args)
+        from arbius_tpu.obs import timed_dispatch
+
+        with timed_dispatch(warm, tag):
+            images = fn(params, *args)
         if self.mesh is not None:
             from arbius_tpu.parallel import meshsolve
 

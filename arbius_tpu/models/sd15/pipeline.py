@@ -160,10 +160,25 @@ class SD15Pipeline:
     # -- compiled bucket -------------------------------------------------
     def _bucket_fn(self, batch: int, height: int, width: int,
                    steps: int, scheduler: str):
+        return self._get_bucket(batch, height, width, steps, scheduler)[0]
+
+    def _get_bucket(self, batch: int, height: int, width: int,
+                    steps: int, scheduler: str):
+        """(fn, warm, tag) — the cached bucket executable, whether it
+        was already built, and its cache tag; the lookup reports
+        through the jit-cache metrics (docs/observability.md) so
+        warm-executable reuse is fleet-visible."""
+        from arbius_tpu.obs import jit_cache_get
+
         key = (batch, height, width, steps, scheduler)
-        cached = self._buckets.get(key)
-        if cached is not None:
-            return cached
+        return jit_cache_get(
+            self._buckets, key,
+            lambda: self._build_bucket(batch, height, width, steps,
+                                       scheduler),
+            tag="sd15." + ".".join(str(k) for k in key))
+
+    def _build_bucket(self, batch: int, height: int, width: int,
+                      steps: int, scheduler: str):
         sampler = get_sampler(scheduler, steps)
         lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
         lat_shape = (batch, lh, lw, self.config.unet.in_channels)
@@ -217,7 +232,6 @@ class SD15Pipeline:
                 in_shardings=(None, spec(2), spec(2), spec(1), spec(1),
                               spec(1)),
                 out_shardings=spec(4))
-        self._buckets[key] = fn
         return fn
 
     # -- public API ------------------------------------------------------
@@ -262,7 +276,8 @@ class SD15Pipeline:
             else [guidance_scale] * batch
         if len(g) != batch:
             raise ValueError("guidance_scale list must align with prompts")
-        fn = self._bucket_fn(batch, height, width, num_inference_steps, scheduler)
+        fn, warm, tag = self._get_bucket(batch, height, width,
+                                         num_inference_steps, scheduler)
         ids_c = self.tokenizer.encode_batch(prompts)
         ids_u = self.tokenizer.encode_batch(negative_prompts)
         vocab = self.config.text.vocab_size
@@ -278,7 +293,10 @@ class SD15Pipeline:
             jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
-        images = fn(params, *args)
+        from arbius_tpu.obs import timed_dispatch
+
+        with timed_dispatch(warm, tag):
+            images = fn(params, *args)
         if self.mesh is not None:
             from arbius_tpu.parallel import meshsolve
 

@@ -125,10 +125,24 @@ class Text2VideoPipeline:
     # -- compiled bucket -------------------------------------------------
     def compiled_bucket(self, batch: int, frames: int, height: int,
                         width: int, steps: int, scheduler: str):
+        return self._get_bucket(batch, frames, height, width, steps,
+                                scheduler)[0]
+
+    def _get_bucket(self, batch: int, frames: int, height: int,
+                    width: int, steps: int, scheduler: str):
+        """(fn, warm, tag) — cache lookup reported through the
+        jit-cache metrics (docs/observability.md)."""
+        from arbius_tpu.obs import jit_cache_get
+
         key = (batch, frames, height, width, steps, scheduler)
-        cached = self._buckets.get(key)
-        if cached is not None:
-            return cached
+        return jit_cache_get(
+            self._buckets, key,
+            lambda: self._build_bucket(batch, frames, height, width,
+                                       steps, scheduler),
+            tag="video." + ".".join(str(k) for k in key))
+
+    def _build_bucket(self, batch: int, frames: int, height: int,
+                      width: int, steps: int, scheduler: str):
         cfg = self.config
         sampler = get_sampler(scheduler, steps)
         lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
@@ -200,7 +214,6 @@ class Text2VideoPipeline:
                 check_rep=False))
         else:
             fn = jax.jit(run)
-        self._buckets[key] = fn
         return fn
 
     # -- public API ------------------------------------------------------
@@ -222,8 +235,9 @@ class Text2VideoPipeline:
             raise ValueError(f"height/width must be multiples of {granule}")
         g = list(guidance_scale) if isinstance(guidance_scale, (list, tuple)) \
             else [guidance_scale] * batch
-        fn = self.compiled_bucket(batch, num_frames, height, width,
-                                  num_inference_steps, scheduler)
+        fn, warm, tag = self._get_bucket(batch, num_frames, height,
+                                         width, num_inference_steps,
+                                         scheduler)
         ids_c = self.tokenizer.encode_batch(prompts)
         ids_u = self.tokenizer.encode_batch(negs)
         vocab = self.config.text.vocab_size
@@ -232,11 +246,14 @@ class Text2VideoPipeline:
                 f"tokenizer produced id >= vocab_size ({vocab}); "
                 "tokenizer and text-encoder config are mismatched")
         seeds_arr = np.asarray(seeds, dtype=np.uint64)
-        out = fn(params,
-                 jnp.asarray(ids_c), jnp.asarray(ids_u),
-                 jnp.asarray(g, jnp.float32),
-                 jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
-                 jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32))
+        from arbius_tpu.obs import timed_dispatch
+
+        with timed_dispatch(warm, tag):
+            out = fn(params,
+                     jnp.asarray(ids_c), jnp.asarray(ids_u),
+                     jnp.asarray(g, jnp.float32),
+                     jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
+                     jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32))
         if self.mesh is not None:
             from arbius_tpu.parallel import meshsolve
 

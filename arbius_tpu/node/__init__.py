@@ -12,6 +12,7 @@ from arbius_tpu.node.config import (
     MiningConfig,
     ModelConfig,
     PipelineConfig,
+    SchedConfig,
     StakeConfig,
     load_config,
     load_deployment,
@@ -42,7 +43,8 @@ __all__ = [
     "MiningConfig", "ModelConfig", "ModelRegistry", "NodeDB",
     "NodeMetrics", "Obs", "PinMismatchError", "PipelineConfig",
     "RVMRunner", "RegisteredModel",
-    "RetriesExhausted", "RpcChain", "SD15Runner", "StakeConfig",
+    "RetriesExhausted", "RpcChain", "SD15Runner", "SchedConfig",
+    "StakeConfig",
     "Text2VideoRunner", "build_registry", "cid_b58", "expretry",
     "load_config", "load_deployment", "solve_cid", "solve_files",
 ]

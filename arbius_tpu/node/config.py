@@ -116,6 +116,35 @@ class PipelineConfig:
 
 
 @dataclass(frozen=True)
+class SchedConfig:
+    """Profit-aware continuous batching (docs/scheduler.md): pack the
+    pending solve queue across families, bucket shapes, and warm
+    executables by predicted fee/chip-second from the learned cost
+    model (node/costmodel.py, sqlite `cost_model` table).
+
+    Disabled by default — `enabled: false` IS the FIFO arrival-order
+    path the node always had. The packer only permutes whole buckets,
+    never the entries inside one, so bytes and CIDs are identical under
+    either policy (tests/test_sched.py pins it)."""
+    enabled: bool = False
+    # per-(model, bucket, layout) samples the cost model must accrue
+    # before its prediction replaces the static estimate (the gate and
+    # the packer both degrade to the exact pre-costsched behavior
+    # until then)
+    min_samples: int = 8
+    # packing-score multiplier for buckets whose executable is already
+    # compiled this life (warm-executable preference; 1.0 disables)
+    warm_boost: float = 1.5
+
+    def __post_init__(self):
+        if self.min_samples < 1:
+            raise ConfigError("sched.min_samples must be >= 1")
+        if self.warm_boost < 1.0:
+            raise ConfigError("sched.warm_boost must be >= 1.0 "
+                              "(1.0 disables the warm preference)")
+
+
+@dataclass(frozen=True)
 class IpfsConfig:
     """Pinning strategy selection (reference `types.ts:3-54` ipfs section):
     local = the node's own ContentStore + gateway (needs store_dir);
@@ -186,6 +215,9 @@ class MiningConfig:
     # staged solve executor (docs/pipeline.md); default OFF = the
     # synchronous reference-equivalent path behind a single switch
     pipeline: PipelineConfig = PipelineConfig()
+    # profit-aware continuous batching (docs/scheduler.md); default OFF
+    # = FIFO arrival-order bucket packing, static-cost gate only
+    sched: SchedConfig = SchedConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -275,6 +307,8 @@ def load_config(raw: str | dict) -> MiningConfig:
     stake = build(StakeConfig, obj.pop("stake", {}), "stake")
     ipfs = build(IpfsConfig, obj.pop("ipfs", {}), "ipfs")
     pipeline = build(PipelineConfig, obj.pop("pipeline", {}), "pipeline")
+    sched = build(SchedConfig, obj.pop("sched", {}), "sched")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
-                      ipfs=ipfs, pipeline=pipeline, **obj), "config")
+                      ipfs=ipfs, pipeline=pipeline, sched=sched, **obj),
+                 "config")

@@ -59,6 +59,10 @@ CREATE TABLE IF NOT EXISTS failed_jobs (
     id INTEGER PRIMARY KEY AUTOINCREMENT, method TEXT, data TEXT);
 CREATE TABLE IF NOT EXISTS pipeline_state (
     taskid TEXT PRIMARY KEY, stage TEXT, cid TEXT);
+CREATE TABLE IF NOT EXISTS cost_model (
+    model TEXT, bucket TEXT, layout TEXT,
+    chip_seconds REAL, samples INT, updated INT,
+    PRIMARY KEY (model, bucket, layout));
 CREATE INDEX IF NOT EXISTS jobs_priority ON jobs(priority);
 """
 
@@ -305,6 +309,34 @@ class NodeDB:
         with self._lock:
             self._conn.execute(
                 "DELETE FROM pipeline_state WHERE taskid = ?", (taskid,))
+            self._commit()
+
+    # -- learned cost model (docs/scheduler.md) --------------------------
+    def upsert_cost_rows(self, rows: list[tuple]) -> None:
+        """Persist fitted cost-model rows: (model, bucket, layout,
+        chip_seconds, samples, updated). Written inside the tick's
+        batch window, so refits cost no extra fsync."""
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO cost_model (model, bucket, layout,"
+                " chip_seconds, samples, updated) VALUES (?,?,?,?,?,?)",
+                rows)
+            self._commit()
+
+    def load_cost_rows(self) -> list[tuple]:
+        """Every persisted (model, bucket, layout, chip_seconds,
+        samples, updated) row, deterministically ordered."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT model, bucket, layout, chip_seconds, samples,"
+                " updated FROM cost_model ORDER BY model, bucket, layout")
+            return [(r["model"], r["bucket"], r["layout"],
+                     float(r["chip_seconds"]), int(r["samples"]),
+                     int(r["updated"])) for r in rows]
+
+    def clear_cost_model(self) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM cost_model")
             self._commit()
 
     def store_contestation(self, taskid: str, validator: str,

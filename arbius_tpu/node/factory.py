@@ -40,6 +40,12 @@ def _needs_cast(params, dtype) -> bool:
 
 
 def _params_for(pipe, m: ModelConfig):
+    # boot-time param-program builds (cast / fused init) land in the
+    # same arbius_compile_seconds histogram as the bucket executables
+    # (docs/observability.md) when an obs context is ambient — a no-op
+    # otherwise, like every obs helper
+    from arbius_tpu.obs import compile_timer
+
     dtype = "bfloat16" if m.weights_dtype == "bfloat16" else None
     mesh = getattr(pipe, "mesh", None)
     if m.checkpoint:
@@ -60,8 +66,9 @@ def _params_for(pipe, m: ModelConfig):
             # it isn't, donation lets XLA free each f32 leaf at its
             # convert instead of holding both full trees live (the
             # 16 GB-chip OOM the random-init path fixes via with_cast)
-            params = jax.jit(lambda p: cast_floating(p, dtype),
-                             donate_argnums=0)(params)
+            with compile_timer(f"boot.cast.{m.template}"):
+                params = jax.jit(lambda p: cast_floating(p, dtype),
+                                 donate_argnums=0)(params)
         elif mesh is None:
             # loaded leaves are host numpy arrays; commit them to the
             # device ONCE here (the cast program used to do this as a
@@ -86,11 +93,13 @@ def _params_for(pipe, m: ModelConfig):
             and dtype is None:
         # fused init + placement: one XLA program whose out_shardings
         # are the rule table's, so the unsharded tree never exists
-        return pipe.init_params_placed(seed=0)
+        with compile_timer(f"boot.init.{m.template}"):
+            return pipe.init_params_placed(seed=0)
     # dtype folds the cast into the init program: a separate cast program
     # holds BOTH trees live (f32 + bf16 — 18 GB for the ~3B kandinsky
     # tree) and OOMs a 16 GB chip; fused, each f32 leaf dies at its cast
-    params = pipe.init_params(seed=0, dtype=dtype)
+    with compile_timer(f"boot.init.{m.template}"):
+        params = pipe.init_params(seed=0, dtype=dtype)
     return pipe.place_params(params) if mesh is not None else params
 
 

@@ -52,7 +52,6 @@ _COUNTERS = {
     "contestations_submitted": "Contestations this node initiated",
     "votes_cast": "Contestation votes cast",
     "vote_finishes": "contestationVoteFinish calls that paid out",
-    "tasks_unprofitable": "Tasks skipped by the profitability gate",
     "tasks_seen": "TaskSubmitted events observed",
     "tasks_invalid": "Tasks marked invalid (bad version or input)",
 }
@@ -72,6 +71,13 @@ class NodeMetrics:
         self._obs = obs
 
     def __getattr__(self, name: str):
+        if name == "tasks_unprofitable":
+            # per-model labeled since the costsched PR (a mispriced
+            # family must be visible) — the back-compat attribute is
+            # the sum over every model child
+            c = self._obs.registry.counter(
+                "arbius_tasks_unprofitable_total", labelnames=("model",))
+            return int(sum(c.summary().values()))
         if name in _COUNTERS:
             return int(self._obs.registry.counter(
                 f"arbius_{name}_total").value())
@@ -122,6 +128,11 @@ class MinerNode:
         reg = self.obs.registry
         for name, help_text in _COUNTERS.items():
             reg.counter(f"arbius_{name}_total", help_text)
+        self._c_unprofitable = reg.counter(
+            "arbius_tasks_unprofitable_total",
+            "Tasks skipped by the profitability gate, by model — a "
+            "mispriced family shows up as its own series "
+            "(docs/scheduler.md)", labelnames=("model",))
         self._h_stage = reg.histogram(
             "arbius_stage_seconds",
             "Wall-clock seconds per solve stage (infer=model+encode+CID "
@@ -144,6 +155,23 @@ class MinerNode:
         self.metrics = NodeMetrics(self.obs)
         self._retry_sleep = lambda s: None  # injectable; chain time is fake
         self.mesh = None          # built + validated at boot (cfg.mesh)
+        # mesh-layout tag of the solve programs (part of every cost-model
+        # key: a tp2 bucket and a single-device bucket are different
+        # programs with different chip-seconds); boot() refines it once
+        # the mesh is up
+        self.solve_layout = "single"
+        # learned chip-seconds table (docs/scheduler.md): always
+        # constructed — the gate consults it whenever rows have accrued,
+        # and with an empty table every prediction is None, so the gate
+        # is bit-for-bit the static path (test-pinned)
+        from arbius_tpu.node.costmodel import CostModel
+
+        self.costmodel = CostModel(min_samples=config.sched.min_samples)
+        self.costmodel.load(self.db)
+        from arbius_tpu.node.sched import CostSched, FifoSched
+
+        self._sched = CostSched(self, config.sched) \
+            if config.sched.enabled else FifoSched()
         self._pipeline = None
         if config.pipeline.enabled:
             from arbius_tpu.node.pipeline import SolvePipeline
@@ -175,6 +203,12 @@ class MinerNode:
 
         self.mesh = meshsolve.boot_mesh(self.config.mesh,
                                         registry=self.obs.registry)
+        if self.mesh is not None:
+            from arbius_tpu.parallel.mesh import mesh_tag
+
+            # cost-model rows are keyed per layout: a relaid-out fleet
+            # must not price its buckets from another layout's programs
+            self.solve_layout = mesh_tag(self.mesh)
         from arbius_tpu.node.factory import mesh_contracts
 
         meshsolve.check_mesh_contract(self.mesh,
@@ -430,8 +464,13 @@ class MinerNode:
             owner=task.owner)
         if not result.filter_passed:
             return
-        if not self._fee_covers_cost(task.fee):
-            self._inc("tasks_unprofitable")
+        # conservative pre-hydration floor — the gate's pre-costsched
+        # placement: a task priced below EVERY cost the hydrated gate
+        # could predict is rejected before its input is even fetched,
+        # so a spam flood never costs chain RPCs or hydration
+        if not self._fee_covers_cost(task.fee, model_id=model_id,
+                                     taskid=taskid):
+            self._c_unprofitable.inc(model=model_id)
             log.info("task %s fee %d below cost floor — skipping",
                      taskid, task.fee)
             return
@@ -451,6 +490,19 @@ class MinerNode:
                            error=f"{type(e).__name__}: {e}")
             return
         hydrated["seed"] = taskid2seed(taskid)
+        # precise per-bucket gate, costsched only: the learned model
+        # prices per bucket SHAPE, and the shape only exists once the
+        # template's defaults are folded in — so this second pass can
+        # only SHARPEN the pre-floor above, never relax it. Without
+        # costsched the static pre-floor already decided, and a second
+        # identical check would just double-journal.
+        if self.config.sched.enabled and not self._fee_covers_cost(
+                task.fee, model_id=model_id, taskid=taskid,
+                hydrated=hydrated):
+            self._c_unprofitable.inc(model=model_id)
+            log.info("task %s fee %d below cost floor — skipping",
+                     taskid, task.fee)
+            return
         if self.mesh is not None:
             # mesh-shape intake gate (docs/multichip.md): a video task
             # whose num_frames does not divide sp cannot run on this
@@ -478,34 +530,110 @@ class MinerNode:
         self.db.queue_job("solve", {"taskid": taskid, "model": model_id},
                           concurrent=False)
 
-    def _fee_covers_cost(self, fee: int) -> bool:
+    def _static_solve_seconds(self) -> float:
+        """The pre-costsched cost estimate, unchanged: observed infer
+        p50 across everything, or the configured prior before any
+        samples. The gate AND the packer degrade to this exact number
+        whenever the learned model has no row (docs/scheduler.md pins
+        that an empty `cost_model` table reproduces it bit-for-bit)."""
+        samples = self._h_stage.values(stage="infer")
+        if samples:
+            return sorted(samples)[len(samples) // 2]
+        return self.config.assumed_solve_seconds
+
+    def _fee_covers_cost(self, fee: int, *, model_id: str | None = None,
+                         taskid: str | None = None,
+                         hydrated: dict | None = None) -> bool:
         """Profitability gate (beyond the reference's static fee filter):
-        estimated solve seconds × operator rate must not exceed the fee.
-        Estimate = observed infer p50, or the configured prior before any
-        samples. Disabled at rate 0."""
+        predicted chip-seconds × operator rate must not exceed the fee.
+        Disabled at rate 0. Learned pricing is opt-in via
+        `sched.enabled` — disabled, the gate is the static path the node
+        always had (estimate = infer p50, else the configured prior).
+
+        Two placements share this method (docs/scheduler.md):
+
+          * `hydrated=None` — the pre-hydration floor, at the gate's
+            pre-costsched position: the estimate is the CHEAPEST cost
+            any hydrated prediction could give (min of the static
+            estimate and every predict-eligible learned row of this
+            model+layout), so it rejects only tasks the precise gate
+            would reject too — spam never costs an input fetch or a
+            hydration. Source `"floor"` when a learned row set it.
+          * `hydrated` given — the precise per-bucket gate (costsched
+            only): the learned row for the task's exact (model, bucket,
+            layout), else the static estimate.
+
+        The FINAL decision is journaled (`gate_decision`: fee,
+        predicted cost, provenance, verdict) exactly once per task —
+        pre-floor accepts under costsched are re-decided (and then
+        journaled) by the precise gate."""
         rate = self.config.min_fee_per_second
         if rate <= 0:
             return True
-        samples = self._h_stage.values(stage="infer")
-        if samples:
-            est = sorted(samples)[len(samples) // 2]
-        else:
-            est = self.config.assumed_solve_seconds
-        return fee >= int(est * rate)
+        from arbius_tpu.node.costmodel import bucket_str
+        from arbius_tpu.node.solver import bucket_key
 
-    def _bucket_key(self, model_id: str, hydrated: dict) -> tuple:
-        # num_frames is part of the compiled program for video templates
-        # (image templates simply have None here) — without it a batched
-        # video dispatch could chunk tasks of different frame counts
-        # into one generate() call
-        return (model_id, hydrated.get("width"), hydrated.get("height"),
-                hydrated.get("num_inference_steps"),
-                hydrated.get("scheduler"), hydrated.get("num_frames"))
+        sched_on = self.config.sched.enabled
+        est = None
+        source = "static"
+        if sched_on and model_id is not None:
+            if hydrated is not None:
+                key = bucket_key(model_id, hydrated)
+                est = self.costmodel.predict(model_id, bucket_str(key),
+                                             self.solve_layout)
+                if est is not None:
+                    source = "cost_model"
+            else:
+                learned = [
+                    r.chip_seconds for r in self.costmodel.rows.values()
+                    if r.model == model_id and r.layout == self.solve_layout
+                    and r.samples >= self.costmodel.min_samples]
+                if learned:
+                    static = self._static_solve_seconds()
+                    est = min(min(learned), static)
+                    if est < static:
+                        source = "floor"
+        if est is None:
+            est = self._static_solve_seconds()
+        floor = int(est * rate)
+        ok = fee >= floor
+        prefloor_accept = hydrated is None and sched_on and ok
+        if not prefloor_accept:
+            self.obs.event("gate_decision", taskid=taskid, model=model_id,
+                           fee=str(fee), predicted_seconds=round(est, 6),
+                           cost_floor=str(floor), source=source,
+                           verdict="accept" if ok else "reject")
+        return ok
+
+    def _bucket_fees(self, entries: list) -> int:
+        """Summed task fees of one bucket (the packer's reward side):
+        from the task cache the event handler filled; a missing row
+        prices as 0 — the packer only deprioritizes it."""
+        total = 0
+        for job, _ in entries:
+            row = self.db.get_task(job.data["taskid"])
+            if row is not None:
+                total += int(row["fee"])
+        return total
+
+    def _ingest_costs(self) -> None:
+        """Fold the tick's tagged stage=infer observations into the
+        cost model, refit, and persist the fitted rows (inside the
+        tick's batch window — no extra fsync)."""
+        if self.costmodel.ingest(self._h_stage):
+            self.costmodel.refit(self.chain.now)
+            self.costmodel.persist(self.db, self.chain.now)
 
     def _process_solve_batch(self, jobs: list[Job]) -> int:
-        """Group solve jobs by shape bucket and run each bucket as ONE
-        batched dispatch (solve_cid_batch → the runner's dp batch path).
-        Commit/reveal stays per-task (chain semantics)."""
+        """Group solve jobs by shape bucket, pack the buckets (FIFO by
+        default; predicted fee/chip-second under costsched —
+        docs/scheduler.md), and run each bucket as ONE batched dispatch
+        (solve_cid_batch → the runner's dp batch path). Commit/reveal
+        stays per-task (chain semantics). Packing permutes whole
+        buckets only; entries inside a bucket keep arrival order, so
+        chunking — and therefore bytes — is packing-invariant."""
+        from arbius_tpu.node.solver import bucket_key
+
         by_bucket: dict[tuple, list[tuple[Job, dict]]] = {}
         for job in jobs:
             hydrated = self.db.get_task_input(job.data["taskid"])
@@ -513,27 +641,42 @@ class MinerNode:
                 self._fail_job(job, ValueError("no stored task input"))
                 continue
             by_bucket.setdefault(
-                self._bucket_key(job.data["model"], hydrated), []).append(
+                bucket_key(job.data["model"], hydrated), []).append(
                 (job, hydrated))
-        if self._pipeline is not None and not self.config.evilmode:
-            # staged executor (docs/pipeline.md): same buckets, same
-            # chunking, same bytes — a pipelined schedule. evilmode (a
-            # contestation drill that fabricates CIDs without solving)
-            # stays on the reference-shaped path below.
-            buckets = [(self.registry.get(model_id), entries)
-                       for (model_id, *_), entries in by_bucket.items()]
-            with span("solve.pipeline", n=sum(len(e) for _, e in buckets)):
-                return self._pipeline.run(buckets)
-        done = 0
-        for (model_id, *_), entries in by_bucket.items():
-            m = self.registry.get(model_id)
-            taskids = [job.data["taskid"] for job, _ in entries]
-            with span("solve.batch", model=model_id, n=len(entries),
-                      taskids=taskids):
-                done += self._solve_bucket(m, entries)
-        return done
+        packed = self._sched.pack(
+            [(key, entries,
+              self._bucket_fees(entries) if self._sched.wants_fees else 0)
+             for key, entries in by_bucket.items()])
+        try:
+            if self._pipeline is not None and not self.config.evilmode:
+                # staged executor (docs/pipeline.md): same buckets, same
+                # chunking, same bytes — a pipelined schedule in packed
+                # order (the device stage feeds in pack order). evilmode
+                # (a contestation drill that fabricates CIDs without
+                # solving) stays on the reference-shaped path below.
+                buckets = [(self.registry.get(b.key[0]), b.entries, b.key)
+                           for b in packed]
+                with span("solve.pipeline",
+                          n=sum(len(e) for _, e, _ in buckets)):
+                    return self._pipeline.run(buckets)
+            done = 0
+            for b in packed:
+                m = self.registry.get(b.key[0])
+                taskids = [job.data["taskid"] for job, _ in b.entries]
+                with span("solve.batch", model=b.key[0], n=len(b.entries),
+                          taskids=taskids):
+                    done += self._solve_bucket(m, b.entries, b.key)
+            return done
+        finally:
+            self._ingest_costs()
 
-    def _solve_bucket(self, m, entries: list[tuple[Job, dict]]) -> int:
+    def _cost_tag(self, key: tuple, n: int) -> str:
+        from arbius_tpu.node.costmodel import bucket_str, make_cost_tag
+
+        return make_cost_tag(key[0], bucket_str(key), self.solve_layout, n)
+
+    def _solve_bucket(self, m, entries: list[tuple[Job, dict]],
+                      key: tuple) -> int:
         t_start = self.chain.now
         # detlint: allow[DET101] obs stage timing; never reaches solve bytes
         w_start = time.perf_counter()
@@ -548,8 +691,14 @@ class MinerNode:
             for job, _ in entries:
                 self._fail_job(job, e)
             return 0
+        # this bucket's executable is compiled now — the packer's
+        # warm-preference signal (docs/scheduler.md)
+        self._sched.mark_warm(key)
+        # tagged with the cost key so the learned model can attribute
+        # the bucket's wall seconds to (model, bucket, layout, n)
         # detlint: allow[DET101] obs stage timing; never reaches solve bytes
-        self._h_stage.observe(time.perf_counter() - w_start, stage="infer")
+        self._h_stage.observe(time.perf_counter() - w_start, stage="infer",
+                              tag=self._cost_tag(key, len(entries)))
         done = 0
         # detlint: allow[DET101] obs stage timing; never reaches solve bytes
         w_commit = time.perf_counter()

@@ -118,6 +118,8 @@ class SolvePipeline:
         self._infer_ok: set = set()
         self._commit_left: dict = {}
         self._commit_acc: dict = {}
+        self._bucket_keys: dict = {}
+        self._bucket_n: dict = {}
         self._cv = threading.Condition()
         # (generation, chunk idx) -> (elapsed, result); guarded by
         # self._cv. The generation token fences off results a worker
@@ -170,8 +172,10 @@ class SolvePipeline:
     # -- the driver (tick thread) -----------------------------------------
     def run(self, buckets: list) -> int:
         """Drive one tick's solve buckets through the staged schedule.
-        `buckets` is [(model, [(Job, hydrated), ...])]; returns the
-        number of jobs completed."""
+        `buckets` is [(model, [(Job, hydrated), ...], bucket_key)] in
+        PACK order — the scheduler's output (node/sched.py) feeds the
+        device stage in the order it chose; returns the number of jobs
+        completed."""
         chunks = self._plan(buckets)
         self._gen += 1
         with self._cv:
@@ -198,6 +202,9 @@ class SolvePipeline:
                 self._infer_left.get(ch.bucket, 0) + 1
             self._commit_left[ch.bucket] = \
                 self._commit_left.get(ch.bucket, 0) + ch.real
+        # bucket -> real task count, frozen before the drains decrement
+        # (the cost tag needs it when the last chunk leaves encode)
+        self._bucket_n = dict(self._commit_left)
         done = 0
         backlog: list = []    # network-stage items, strict task order
         inflight: list = []   # dispatched chunks not yet consumed
@@ -249,7 +256,9 @@ class SolvePipeline:
     def _plan(self, buckets: list) -> list[_Chunk]:
         b = max(1, self.node.config.canonical_batch)
         chunks: list[_Chunk] = []
-        for bi, (model, entries) in enumerate(buckets):
+        self._bucket_keys: dict[int, tuple] = {}
+        for bi, (model, entries, key) in enumerate(buckets):
+            self._bucket_keys[bi] = key
             items = [(h, h["seed"]) for _, h in entries]
             for ci, (padded, real) in enumerate(chunk_items(items, b)):
                 chunks.append(_Chunk(
@@ -288,6 +297,9 @@ class SolvePipeline:
         # detlint: allow[DET101] obs stage timing; never reaches solve bytes
         ch.dev_seconds = time.perf_counter() - t0
         self._h_stage.observe(ch.dev_seconds, stage="device")
+        # dispatch succeeded ⇒ the bucket's executable is compiled —
+        # feed the packer's warm-preference set (docs/scheduler.md)
+        self.node._sched.mark_warm(self._bucket_keys[ch.bucket])
         for job, _ in ch.entries:
             self._stage_event(job.data["taskid"], "solve", job.id)
         if self._workers:
@@ -390,7 +402,11 @@ class SolvePipeline:
             self.node._h_stage.observe(
                 # detlint: allow[DET101] obs stage timing; never reaches solve bytes
                 time.perf_counter() - self._infer_start[bucket],
-                stage="infer")
+                stage="infer",
+                # cost-tagged exactly like the serial path, so the
+                # learned model reads one signal whichever schedule ran
+                tag=self.node._cost_tag(self._bucket_keys[bucket],
+                                        self._bucket_n[bucket]))
 
     # -- bookkeeping -------------------------------------------------------
     def _stage_event(self, taskid: str, stage: str, jobid: int,

@@ -560,9 +560,27 @@ class ControlRPC:
 
     def debug_view(self, path: str) -> tuple[int, object]:
         """GET /debug/trace?taskid=0x… → the task's span trees;
-        GET /debug/journal[?limit=N&kind=K] → raw journal events."""
+        GET /debug/journal[?limit=N&kind=K] → raw journal events;
+        GET /debug/costmodel → the learned cost table + packer state."""
         parts = urlsplit(path)
         q = parse_qs(parts.query)
+        if parts.path == "/debug/costmodel":
+            # the scheduler's whole pricing state in one view
+            # (docs/scheduler.md): fitted rows, packer policy + warm
+            # set + last pack order, and the static fallback the gate
+            # degrades to
+            cfg = self.node.config
+            return 200, {
+                "cost_model": self.node.costmodel.snapshot(),
+                "sched": self.node._sched.snapshot(),
+                # ground truth for the packer's warm preference: every
+                # executable-cache tag that actually compiled this life
+                # (obs.jit_warm) — audit `sched.warm` against it
+                "jit_warm": sorted(self.node.obs.jit_warm),
+                "layout": self.node.solve_layout,
+                "min_fee_per_second": str(cfg.min_fee_per_second),
+                "static_seconds": self.node._static_solve_seconds(),
+            }
         if parts.path == "/debug/trace":
             taskid = (q.get("taskid") or [""])[0]
             if not taskid:

@@ -58,6 +58,19 @@ class ModelRegistry:
         return list(self._models)
 
 
+def bucket_key(model_id: str, hydrated: dict) -> tuple:
+    """The shape-bucket identity of one task: every field that is part
+    of the compiled XLA program (w/h/steps/scheduler, and num_frames
+    for video templates — image templates simply carry None there).
+    Tasks sharing a key run as ONE batched dispatch; the key is also
+    the cost model's bucket feature and the packer's unit of
+    reordering (node/sched.py, docs/scheduler.md), so it lives here —
+    next to the chunking it must agree with — not in the node."""
+    return (model_id, hydrated.get("width"), hydrated.get("height"),
+            hydrated.get("num_inference_steps"),
+            hydrated.get("scheduler"), hydrated.get("num_frames"))
+
+
 def _check_declared(model: RegisteredModel, files: dict) -> dict:
     declared = {o.filename for o in model.template.outputs}
     if set(files) != declared:

@@ -52,6 +52,11 @@ class Obs:
         self.journal = EventJournal(journal_capacity, now_fn=now_fn)
         self.tracer = Tracer(self.journal, registry=self.registry,
                              now_fn=now_fn, enabled=enabled)
+        # executable-cache tags that built (compiled) under this obs —
+        # the per-process warm-set behind the arbius_jit_cache_*
+        # counters (jit_cache_get below), served on /debug/costmodel as
+        # ground truth for the packer's warm set (docs/scheduler.md)
+        self.jit_warm: set = set()
 
     def span(self, name: str, **attrs):
         if not self.enabled:
@@ -95,8 +100,85 @@ def span(name: str, **attrs):
     return obs.tracer.span(name, **attrs)
 
 
+# -- jit-cache observability (docs/scheduler.md, docs/observability.md) -----
+#
+# Every bucket-executable cache in the tree (the model pipelines'
+# `_buckets`, the meshsolve probes' `_fns`) reports through these two
+# helpers, so warm-executable reuse — the signal the Gemma-on-TPU
+# serving comparison (PAPERS.md) shows dominates chip utilization — is
+# measurable fleet-wide and the profit scheduler's warm preference has
+# a ground-truth counter to be audited against. Ambient-obs no-ops,
+# like span(): library code stays node-free.
+
+_JIT_HITS_HELP = ("Bucket-executable cache lookups answered by an "
+                  "already-built (warm) executable")
+_JIT_MISS_HELP = ("Bucket-executable cache lookups that had to build "
+                  "(trace + compile) a new executable")
+_COMPILE_HELP = ("Wall seconds of a bucket executable's first dispatch "
+                 "— trace + XLA build dominated (tagged per executable "
+                 "cache key in the recent window)")
+
+
+def jit_cache_get(cache: dict, key, build, tag: str | None = None):
+    """Get-or-build a cached bucket executable with jit-cache obs:
+    increments `arbius_jit_cache_{hits,misses}_total`, records `tag`
+    into the active obs' warm set on build, and returns
+    `(fn, warm, tag)` — `fn` is exactly what `build()` returned
+    (graphlint traces these same callables, so nothing may wrap them),
+    and `tag` echoes the argument so dispatch sites hand the SAME
+    string to `timed_dispatch` instead of rebuilding it."""
+    obs = _ACTIVE.get()
+    fn = cache.get(key)
+    if fn is not None:
+        if obs is not None:
+            obs.registry.counter("arbius_jit_cache_hits_total",
+                                 _JIT_HITS_HELP).inc()
+        return fn, True, tag
+    if obs is not None:
+        obs.registry.counter("arbius_jit_cache_misses_total",
+                             _JIT_MISS_HELP).inc()
+        if tag is not None:
+            obs.jit_warm.add(tag)
+    fn = cache[key] = build()
+    return fn, False, tag
+
+
+def timed_dispatch(warm: bool, tag: str | None = None):
+    """The one cold/warm dispatch idiom every bucket-executable call
+    site shares: a no-op context when the executable is warm, else
+    `compile_timer(tag)` around the first (compile-dominated) call."""
+    if warm:
+        return nullcontext()
+    return compile_timer(tag)
+
+
+@contextmanager
+def compile_timer(tag: str | None = None):
+    """Time a cold bucket executable's FIRST dispatch into
+    `arbius_compile_seconds` (jit compile is synchronous inside that
+    call; execution is async-dispatched, so the wall window is
+    trace+build dominated). Call sites wrap only the cold call —
+    `jit_cache_get`'s `warm` flag says which one that is."""
+    obs = _ACTIVE.get()
+    if obs is None:
+        yield
+        return
+    import time
+
+    # detlint: allow[DET101] obs compile timing; never reaches solve bytes
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        obs.registry.histogram(
+            "arbius_compile_seconds", _COMPILE_HELP).observe(
+            # detlint: allow[DET101] obs compile timing; never reaches solve bytes
+            time.perf_counter() - t0, tag=tag)
+
+
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "EventJournal", "Gauge", "Histogram",
-    "MetricsRegistry", "Obs", "Span", "Tracer", "current_obs", "span",
-    "task_trace", "use_obs",
+    "MetricsRegistry", "Obs", "Span", "Tracer", "compile_timer",
+    "current_obs", "jit_cache_get", "span", "task_trace",
+    "timed_dispatch", "use_obs",
 ]

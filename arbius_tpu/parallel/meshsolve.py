@@ -382,9 +382,20 @@ class ShardedImageProbe(_ProbeBase):
         return NamedSharding(self.mesh, P())
 
     def _fn(self, batch: int):
-        cached = self._fns.get(batch)
-        if cached is not None:
-            return cached
+        return self._get_fn(batch)[0]
+
+    def _get_fn(self, batch: int):
+        """(fn, warm, tag) via the shared jit-cache obs helper
+        (docs/observability.md) — the probes report warm-executable
+        reuse exactly like the model pipelines, so bench `sched_ab` and
+        the simnet flood see real jit-cache counters."""
+        from arbius_tpu.obs import jit_cache_get
+
+        return jit_cache_get(self._fns, batch,
+                             lambda: self._build_fn(batch),
+                             tag=f"meshprobe.img.b{batch}")
+
+    def _build_fn(self, batch: int):
         import jax
         import jax.numpy as jnp
 
@@ -398,19 +409,18 @@ class ShardedImageProbe(_ProbeBase):
             return jax.vmap(per)(seeds)
 
         if self.mesh is None:
-            fn = jax.jit(run)
-        else:
-            spec, _ = batch_specs(self.mesh, batch)
-            fn = jax.jit(run,
-                         in_shardings=(self._param_sharding(), spec(1)),
-                         out_shardings=spec(3))
-        self._fns[batch] = fn
-        return fn
+            return jax.jit(run)
+        spec, _ = batch_specs(self.mesh, batch)
+        return jax.jit(run,
+                       in_shardings=(self._param_sharding(), spec(1)),
+                       out_shardings=spec(3))
 
     def dispatch(self, items: list):
         if self.gate is not None:
             self.gate()
         import jax
+
+        from arbius_tpu.obs import timed_dispatch
 
         if self._params is None:
             raw = _probe_params()
@@ -419,7 +429,9 @@ class ShardedImageProbe(_ProbeBase):
                 else jax.device_put(raw)
         seeds = self._seeds(items)
         (seeds_dev,) = shard_batch(self.mesh, seeds)
-        out = self._fn(len(items))(self._params, seeds_dev)
+        fn, warm, tag = self._get_fn(len(items))
+        with timed_dispatch(warm, tag):
+            out = fn(self._params, seeds_dev)
         record_bucket_estimate(self._est, len(items), self.mesh, out,
                                len(items), params=self._params)
         return out
@@ -443,29 +455,38 @@ class ShardedSeqProbe(_ProbeBase):
         self._params = None
 
     def _fn(self, batch: int):
-        cached = self._fns.get(batch)
-        if cached is not None:
-            return cached
-        # shard_map hard-partitions the batch axis — an under-filled
-        # bucket (batch % dp != 0) degrades to the single-device program,
-        # whose bytes the shard_map build matches by construction
-        mesh = self.mesh
-        if mesh is not None and batch % mesh.shape.get("dp", 1):
-            mesh = None
-        fn = build_seq_probe_fn(mesh, self.frames)
-        self._fns[batch] = fn
-        return fn
+        return self._get_fn(batch)[0]
+
+    def _get_fn(self, batch: int):
+        from arbius_tpu.obs import jit_cache_get
+
+        def build():
+            # shard_map hard-partitions the batch axis — an under-filled
+            # bucket (batch % dp != 0) degrades to the single-device
+            # program, whose bytes the shard_map build matches by
+            # construction
+            mesh = self.mesh
+            if mesh is not None and batch % mesh.shape.get("dp", 1):
+                mesh = None
+            return build_seq_probe_fn(mesh, self.frames)
+
+        return jit_cache_get(self._fns, batch, build,
+                             tag=f"meshprobe.seq.b{batch}.f{self.frames}")
 
     def dispatch(self, items: list):
         if self.gate is not None:
             self.gate()
         import jax
 
+        from arbius_tpu.obs import timed_dispatch
+
         if self._params is None:
             self._params = jax.device_put(_probe_params())
         seeds = self._seeds(items)
         (seeds_dev,) = shard_batch(self.mesh, seeds)
-        out = self._fn(len(items))(self._params, seeds_dev)
+        fn, warm, tag = self._get_fn(len(items))
+        with timed_dispatch(warm, tag):
+            out = fn(self._params, seeds_dev)
         record_bucket_estimate(self._est, len(items), self.mesh, out,
                                len(items))
         return out

@@ -51,7 +51,7 @@ from arbius_tpu.node import (
     NodeDB,
     RegisteredModel,
 )
-from arbius_tpu.node.config import PipelineConfig
+from arbius_tpu.node.config import PipelineConfig, SchedConfig
 from arbius_tpu.node.solver import EVIL_CID
 from arbius_tpu.obs import use_obs
 from arbius_tpu.sim.clock import VirtualClock
@@ -196,6 +196,15 @@ class SimHarness:
             self.user_wallet.address, self.user_wallet.address, 0,
             b'{"meta":{"title":"simnet"}}')
         self.model_id = "0x" + mid_b.hex()
+        # mixed-family scenarios (sched-flood, docs/scheduler.md):
+        # additional registered models share the template but form their
+        # own buckets, so the packer has real cross-family choices
+        self.model_ids = [self.model_id]
+        for f in range(1, scenario.families):
+            mb = self.engine.register_model(
+                self.user_wallet.address, self.user_wallet.address, 0,
+                f'{{"meta":{{"title":"simnet-f{f}"}}}}'.encode())
+            self.model_ids.append("0x" + mb.hex())
         self.user_client = EngineRpcClient(
             _CleanTransport(self.dev), self.dev.engine_address,
             self.user_wallet, chain_id=CHAIN_ID)
@@ -222,7 +231,13 @@ class SimHarness:
         chain = AuditedRpcChain(client, self.dev.token_address, self.plane)
         cfg = MiningConfig(
             db_path=":memory:",  # unused: db object injected below
-            models=(ModelConfig(id=self.model_id, template="anythingv3"),),
+            models=tuple(ModelConfig(id=mid, template="anythingv3")
+                         for mid in self.model_ids),
+            # costsched packer (docs/scheduler.md) when the scenario
+            # says so: bucket order becomes the scheduler's choice and
+            # every SIM1xx invariant must hold regardless
+            sched=SchedConfig(enabled=True) if self.scenario.sched
+            else SchedConfig(),
             compile_cache_dir=None,
             obs_journal_capacity=16384,
             retry_max_delay=self.result.retry_max_delay,
@@ -252,9 +267,10 @@ class SimHarness:
         else:
             runner = FaultyRunner(self.plane)
         registry = ModelRegistry()
-        registry.register(RegisteredModel(
-            id=self.model_id, template=load_template("anythingv3"),
-            runner=runner))
+        for mid in self.model_ids:
+            registry.register(RegisteredModel(
+                id=mid, template=load_template("anythingv3"),
+                runner=runner))
         db = NodeDB(self.db_path)
         node = self.node_cls(chain, cfg, registry, db=db, store=None,
                              pinner=SimPinner(self.plane))
@@ -286,17 +302,25 @@ class SimHarness:
             # undecodable JSON: hydration must fail and the node must
             # remember the task as invalid (contestation evidence)
             return b'{"prompt": broken'
-        return json.dumps({"prompt": f"simnet task {i} "
-                                     f"{self._rng_work.u64():x}",
-                           "negative_prompt": ""},
-                          sort_keys=True).encode()
+        obj = {"prompt": f"simnet task {i} {self._rng_work.u64():x}",
+               "negative_prompt": ""}
+        if i % self.scenario.families:
+            # the mixed-family flood also mixes SHAPES, so the packer
+            # reorders across genuinely different buckets (width is part
+            # of the bucket key; the template enum admits 256)
+            obj["width"] = 256
+            obj["height"] = 256
+        return json.dumps(obj, sort_keys=True).encode()
 
     def _submit_task(self, i: int) -> None:
         invalid = self._rng_work.chance(self.scenario.invalid_rate)
         evil = (not invalid) and self._rng_work.chance(self.scenario.evil_rate)
-        fee = self.scenario.fee_wad * WAD
+        family = i % self.scenario.families
+        # fees differ per family so costsched's fee/chip-second ranking
+        # has a real gradient to act on
+        fee = self.scenario.fee_wad * WAD * (1 + family)
         self.user_client.send("submitTask", [
-            0, self.user_wallet.address, self.model_id, fee,
+            0, self.user_wallet.address, self.model_ids[family], fee,
             self._task_input(i, invalid)])
         tid = self._submitted_ids[-1]
         self.result.tasks[tid] = TaskFlags(index=i, invalid=invalid,
@@ -354,7 +378,11 @@ class SimHarness:
             # a restart swaps self.node — re-enter the obs context each
             # round so sim counters land in the live node's registry
             with use_obs(self.node.obs):
-                if submitted < scenario.tasks:
+                # flood scenarios submit bursts so the queue actually
+                # holds multiple buckets when the packer runs
+                for _ in range(max(1, scenario.burst)):
+                    if submitted >= scenario.tasks:
+                        break
                     self._submit_task(submitted)
                     submitted += 1
                 self._tick()

@@ -56,6 +56,9 @@ class Scenario:
     strict: bool = False           # every normal task MUST end claimed
     tick_seconds: int = 5          # virtual seconds between rounds
     max_rounds: int = 600          # liveness bound (SIM108 if exceeded)
+    burst: int = 1                 # tasks submitted per round (flood > 1)
+    families: int = 1              # registered model families to mix
+    sched: bool = False            # costsched packer on (docs/scheduler.md)
     faults: FaultSpec = field(default_factory=FaultSpec)
 
     def to_json(self) -> dict:
@@ -103,6 +106,15 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
                     "wrong CID; the node must contest, vote, and finish "
                     "every dispute",
         tasks=6, evil_rate=0.5, strict=True),
+    Scenario(
+        name="sched-flood",
+        description="mixed-family task flood under the costsched packer: "
+                    "two model families, bursts of 4, varied shapes and "
+                    "fees — the scheduler reorders buckets freely and "
+                    "every SIM1xx invariant (incl. per-task CID "
+                    "stability) must hold regardless",
+        tasks=16, burst=4, families=2, sched=True, strict=True,
+        faults=FaultSpec(latency_max=3, runner_slow_seconds=2)),
     Scenario(
         name="chaos",
         description="everything at once, at moderated rates — the soak "

@@ -1,0 +1,675 @@
+"""costsched — profit-aware continuous batching (arbius_tpu/node/sched.py
++ costmodel.py, docs/scheduler.md).
+
+The load-bearing property mirrors pipeline/mesh: the packer may only
+change the ORDER buckets dispatch in, never the bytes — solution files
+and CIDs must be identical costsched-on vs FIFO-off for image-shaped
+and video-shaped fakes at canonical_batch 1 and 4. On top of that: the
+learned fit is deterministic and golden-pinned, the gate degrades to
+the exact static behavior on an empty cost_model table, fitted rows
+persist across node lives, and the simnet mixed-family flood holds
+every SIM1xx invariant with the scheduler reordering freely.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from arbius_tpu.chain import WAD, Engine, TokenLedger
+from arbius_tpu.l0.cid import cid_hex, cid_of_solution_files
+from arbius_tpu.node import (
+    LocalChain,
+    MinerNode,
+    MiningConfig,
+    ModelConfig,
+    ModelRegistry,
+    RegisteredModel,
+)
+from arbius_tpu.node.config import ConfigError, SchedConfig, load_config
+from arbius_tpu.node.costmodel import (
+    CostModel,
+    bucket_str,
+    make_cost_tag,
+    parse_cost_tag,
+    seeded_fit,
+)
+from arbius_tpu.node.db import NodeDB
+from arbius_tpu.node.solver import bucket_key, chunk_items
+from arbius_tpu.templates.engine import load_template
+from tests.test_node import MINER, MODEL_ADDR, USER, drain
+
+SCHED_ON = SchedConfig(enabled=True)
+
+
+class _RecordingPinner:
+    def __init__(self):
+        self.pinned: dict[str, dict] = {}
+
+    def pin_files(self, files: dict, taskid: str = "") -> bytes:
+        self.pinned[taskid] = dict(files)
+        return cid_of_solution_files(files)
+
+    def pin_blob(self, content: bytes, filename: str = "input") -> bytes:
+        from arbius_tpu.l0.cid import dag_of_file
+
+        return dag_of_file(content).cid
+
+
+class _ImageFakeRunner:
+    """SD15Runner-shaped (dispatch/finalize) deterministic PNG-ish
+    bytes; logs dispatches so pack order is observable."""
+
+    def __init__(self, log=None):
+        self.log = log if log is not None else []
+
+    def __call__(self, hydrated, seed):
+        return self.finalize(self.dispatch([(hydrated, seed)]), 1)[0]
+
+    def run_batch(self, items):
+        return self.finalize(self.dispatch(items), len(items))
+
+    def dispatch(self, items):
+        self.log.append([h.get("prompt") for h, _ in items])
+        return [self._bytes(h, s) for h, s in items]
+
+    def finalize(self, dev, n_real):
+        return [{"out-1.png": dev[i]} for i in range(n_real)]
+
+    @staticmethod
+    def _bytes(hydrated, seed):
+        blob = json.dumps({k: v for k, v in sorted(hydrated.items())
+                           if k != "seed"}).encode()
+        return b"\x89PNG" + blob + seed.to_bytes(8, "big")
+
+
+class _VideoFakeRunner(_ImageFakeRunner):
+    """Text2VideoRunner-shaped: same surface, mp4-ish bytes, and the
+    bucket key genuinely varies over num_frames (the video-family
+    distinction the packer must respect)."""
+
+    def finalize(self, dev, n_real):
+        return [{"out-1.mp4": b"\x00\x00\x00 ftypisom" + dev[i]}
+                for i in range(n_real)]
+
+
+def _world(families, *, sched=None, canonical_batch=1, pipeline=None,
+           min_fee_per_second=0, db_path=":memory:", registry=None,
+           **cfg_overrides):
+    """Engine + node over N model families. `families` is a list of
+    (template_name, runner); returns (eng, node, [model_ids], pinner)."""
+    from arbius_tpu.node.config import PipelineConfig
+
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=10_000)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    for a in (MINER, USER):
+        tok.mint(a, 1_000 * WAD)
+        tok.approve(a, Engine.ADDRESS, 10**30)
+    mids = []
+    reg = registry or ModelRegistry()
+    model_cfgs = []
+    for template, runner in families:
+        mid = "0x" + eng.register_model(USER, MODEL_ADDR, 0, b"{}").hex()
+        reg.register(RegisteredModel(
+            id=mid, template=load_template(template), runner=runner))
+        model_cfgs.append(ModelConfig(id=mid, template=template))
+        mids.append(mid)
+    chain = LocalChain(eng, MINER)
+    chain.validator_deposit(100 * WAD)
+    cfg = MiningConfig(
+        db_path=db_path, models=tuple(model_cfgs),
+        canonical_batch=canonical_batch,
+        sched=sched or SchedConfig(),
+        pipeline=pipeline or PipelineConfig(),
+        min_fee_per_second=min_fee_per_second,
+        **cfg_overrides)
+    pinner = _RecordingPinner()
+    node = MinerNode(chain, cfg, reg, pinner=pinner)
+    node.boot()
+    drain(node)
+    return eng, node, mids, pinner
+
+
+def _submit(eng, mid, raw, fee=0):
+    return "0x" + eng.submit_task(
+        USER, 0, USER, bytes.fromhex(mid[2:]), fee,
+        json.dumps(raw, sort_keys=True).encode()).hex()
+
+
+IMG_SHAPES = [{"width": 256, "height": 256}, {"width": 512, "height": 512}]
+VID_SHAPES = [{"num_frames": 8}, {"num_frames": 16}]
+
+
+def _mine_mixed(runner_cls, template, shapes, *, sched, canonical_batch,
+                n_tasks=8):
+    """Drive a mixed-shape queue through one world; returns
+    {taskid: (cid, pinned files)}."""
+    eng, node, (mid,), pinner = _world(
+        [(template, runner_cls())], sched=sched,
+        canonical_batch=canonical_batch)
+    tids = []
+    for i in range(n_tasks):
+        raw = {"prompt": f"task {i}", "negative_prompt": "",
+               **shapes[i % len(shapes)]}
+        tids.append(_submit(eng, mid, raw, fee=(1 + i % 3) * WAD))
+    drain(node)
+    out = {}
+    for tid in tids:
+        sol = eng.solutions[bytes.fromhex(tid[2:])]
+        out[tid] = ("0x" + sol.cid.hex(), pinner.pinned.get(tid))
+    node.close()
+    return out
+
+
+# -- byte equality: the golden acceptance gate ------------------------------
+
+@pytest.mark.parametrize("batch", [1, 4])
+@pytest.mark.parametrize("template,runner_cls,shapes", [
+    ("anythingv3", _ImageFakeRunner, IMG_SHAPES),
+    ("zeroscopev2xl", _VideoFakeRunner, VID_SHAPES),
+])
+def test_cids_and_bytes_identical_costsched_on_vs_fifo(
+        template, runner_cls, shapes, batch):
+    fifo = _mine_mixed(runner_cls, template, shapes, sched=None,
+                       canonical_batch=batch)
+    cost = _mine_mixed(runner_cls, template, shapes, sched=SCHED_ON,
+                       canonical_batch=batch)
+    assert fifo.keys() == cost.keys()
+    for tid in fifo:
+        cid_f, files_f = fifo[tid]
+        cid_c, files_c = cost[tid]
+        assert cid_f == cid_c, f"CID drift for {tid}"
+        assert files_f == files_c, f"byte drift for {tid}"
+        assert cid_c == cid_hex(cid_of_solution_files(files_c))
+
+
+def test_bytes_identical_with_pipeline_and_costsched():
+    """Packer + staged executor together: pack order feeds the device
+    stage, bytes still identical to the plain FIFO synchronous path."""
+    from arbius_tpu.node.config import PipelineConfig
+
+    pipe = PipelineConfig(enabled=True, depth=2, encode_workers=2,
+                          max_inflight_pins=2)
+
+    def run(sched, pipeline):
+        eng, node, (mid,), pinner = _world(
+            [("anythingv3", _ImageFakeRunner())], sched=sched,
+            canonical_batch=4, pipeline=pipeline)
+        tids = [_submit(eng, mid,
+                        {"prompt": f"t{i}", "negative_prompt": "",
+                         **IMG_SHAPES[i % 2]}, fee=(1 + i) * WAD)
+                for i in range(6)]
+        drain(node)
+        out = {t: pinner.pinned.get(t) for t in tids}
+        node.close()
+        return out
+
+    assert run(None, None) == run(SCHED_ON, pipe)
+
+
+# -- packing order ----------------------------------------------------------
+
+def _prime(node, mid, shape, per_task_seconds, n=None):
+    """Hand the cost model enough samples that `predict` answers."""
+    key = bucket_key(mid, shape)
+    for _ in range(n or node.costmodel.min_samples):
+        node.costmodel.observe(mid, bucket_str(key), node.solve_layout,
+                               per_task_seconds)
+    node.costmodel.refit(now=0)
+
+
+def test_packer_orders_by_fee_per_chip_second():
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner())],
+                                  sched=SCHED_ON)
+    slow = {"width": 512, "height": 512}
+    fast = {"width": 256, "height": 256}
+    _prime(node, mid, slow, 10.0)
+    _prime(node, mid, fast, 1.0)
+    k_slow, k_fast = bucket_key(mid, slow), bucket_key(mid, fast)
+    packed = node._sched.pack([(k_slow, [("j", slow)], 5 * WAD),
+                               (k_fast, [("j", fast)], 5 * WAD)])
+    # same fee, 10× cheaper chip seconds → fast bucket first
+    assert [b.key for b in packed] == [k_fast, k_slow]
+    assert packed[0].source == "cost_model"
+    # warm preference: warm the slow bucket and give it a fee edge too
+    node._sched.mark_warm(k_slow)
+    packed = node._sched.pack([(k_slow, [("j", slow)], 50 * WAD),
+                               (k_fast, [("j", fast)], 5 * WAD)])
+    assert packed[0].key == k_fast or packed[0].warm  # scored, not FIFO
+    # equal everything → warm wins via the boost
+    node._sched.mark_warm(k_slow)
+    a = node._sched.pack([(k_slow, [("j", slow)], 5 * WAD),
+                          (k_fast, [("j", fast)], 50 * WAD)])
+    assert a[0].key == k_fast
+    node.close()
+
+
+def test_packer_reorder_visible_in_dispatch_log_and_journal():
+    log = []
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner(log))],
+                                  sched=SCHED_ON)
+    # priming keys must match what hydration produces: the template's
+    # defaults fill steps/scheduler (anythingv3 → 20, DPMSolverMultistep)
+    defaults = {"num_inference_steps": 20,
+                "scheduler": "DPMSolverMultistep"}
+    slow = {"width": 512, "height": 512, **defaults}
+    fast = {"width": 256, "height": 256, **defaults}
+    _prime(node, mid, slow, 10.0)
+    _prime(node, mid, fast, 1.0)
+    # arrival order: slow first — packer must flip it (equal fees)
+    _submit(eng, mid, {"prompt": "slow", "negative_prompt": "", **slow},
+            fee=WAD)
+    _submit(eng, mid, {"prompt": "fast", "negative_prompt": "", **fast},
+            fee=WAD)
+    log.clear()
+    drain(node)
+    assert log[0] == ["fast"] and log[1] == ["slow"]
+    packs = node.obs.journal.events(kind="sched_pack")
+    assert packs and packs[-1]["order"][0]["bucket"].startswith("256x256")
+    node.close()
+
+
+def test_fifo_default_keeps_arrival_order():
+    log = []
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner(log))])
+    _prime(node, mid, {"width": 512, "height": 512}, 10.0)
+    _submit(eng, mid, {"prompt": "a", "negative_prompt": "",
+                       "width": 512, "height": 512}, fee=WAD)
+    _submit(eng, mid, {"prompt": "b", "negative_prompt": "",
+                       "width": 256, "height": 256}, fee=WAD)
+    log.clear()
+    drain(node)
+    assert log == [["a"], ["b"]]
+    node.close()
+
+
+# -- the profitability gate -------------------------------------------------
+
+def test_empty_cost_model_reproduces_static_gate_exactly():
+    """Acceptance pin: with no cost_model rows the gate IS the static
+    path — same decisions as a sched-disabled node for every fee, both
+    before any samples (assumed_solve_seconds prior) and after (global
+    infer p50)."""
+    def build(sched):
+        return _world([("anythingv3", _ImageFakeRunner())], sched=sched,
+                      min_fee_per_second=WAD, assumed_solve_seconds=7.0)
+
+    eng_a, node_a, (mid_a,), _ = build(None)
+    eng_b, node_b, (mid_b,), _ = build(SCHED_ON)
+    hyd = {"prompt": "x", "negative_prompt": "", "width": 512,
+           "height": 512}
+    fees = [0, 6 * WAD, 7 * WAD, 8 * WAD, 10**30]
+    for fee in fees:
+        assert node_a._fee_covers_cost(fee, model_id=mid_a, hydrated=hyd) \
+            == node_b._fee_covers_cost(fee, model_id=mid_b, hydrated=hyd) \
+            == (fee >= 7 * WAD)
+    # feed both the same infer sample; the static p50 must take over
+    for node in (node_a, node_b):
+        node._h_stage.observe(3.0, stage="infer")
+    for fee in fees:
+        assert node_a._fee_covers_cost(fee, model_id=mid_a, hydrated=hyd) \
+            == node_b._fee_covers_cost(fee, model_id=mid_b, hydrated=hyd) \
+            == (fee >= 3 * WAD)
+    ev = node_b.obs.journal.events(kind="gate_decision")
+    assert ev and all(e["source"] in ("static",) for e in ev)
+    node_a.close()
+    node_b.close()
+
+
+def test_learned_gate_prices_per_bucket_and_journals():
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner())],
+                                  sched=SCHED_ON, min_fee_per_second=WAD,
+                                  assumed_solve_seconds=2.0)
+    slow = {"prompt": "s", "negative_prompt": "", "width": 512,
+            "height": 512}
+    _prime(node, mid, slow, 9.0)
+    # static prior would accept 5 WAD (floor 2); the learned row knows
+    # this bucket really costs 9 s/task and rejects it
+    assert not node._fee_covers_cost(5 * WAD, model_id=mid, hydrated=slow,
+                                     taskid="0xabc")
+    assert node._fee_covers_cost(9 * WAD, model_id=mid, hydrated=slow)
+    ev = node.obs.journal.events(kind="gate_decision")
+    assert ev[-2]["source"] == "cost_model"
+    assert ev[-2]["verdict"] == "reject"
+    assert ev[-2]["taskid"] == "0xabc"
+    assert ev[-1]["verdict"] == "accept"
+    # an unknown bucket still prices statically
+    cold = {"prompt": "c", "negative_prompt": "", "width": 128,
+            "height": 128}
+    assert node._fee_covers_cost(2 * WAD, model_id=mid, hydrated=cold)
+    assert node.obs.journal.events(kind="gate_decision")[-1]["source"] \
+        == "static"
+    node.close()
+
+
+def test_gate_ignores_learned_rows_when_sched_disabled():
+    """`enabled: false` is the full pre-costsched path: even with
+    predict-eligible rows accrued (the model keeps learning for
+    /debug and a later enable), decisions stay static."""
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner())],
+                                  min_fee_per_second=WAD,
+                                  assumed_solve_seconds=2.0)
+    slow = {"prompt": "s", "negative_prompt": "", "width": 512,
+            "height": 512}
+    _prime(node, mid, slow, 9.0)
+    # the learned row (9 s) would reject 5 WAD; the static prior (2 s)
+    # accepts it — and static must win with the scheduler disabled
+    assert node._fee_covers_cost(5 * WAD, model_id=mid, hydrated=slow)
+    ev = node.obs.journal.events(kind="gate_decision")
+    assert ev[-1]["source"] == "static"
+    assert ev[-1]["predicted_seconds"] == 2.0
+    node.close()
+
+
+def test_prefloor_rejects_spam_before_input_fetch():
+    """An obviously underpriced task never costs an input fetch or a
+    hydration (the gate's pre-costsched placement), and every task
+    journals exactly ONE gate_decision."""
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner())],
+                                  sched=SCHED_ON, min_fee_per_second=WAD,
+                                  assumed_solve_seconds=10.0)
+    fetched = []
+    orig = node.chain.get_task_input_bytes
+    node.chain.get_task_input_bytes = \
+        lambda tid: (fetched.append(tid), orig(tid))[1]
+    cheap = _submit(eng, mid, {"prompt": "spam", "negative_prompt": ""},
+                    fee=0)
+    drain(node)
+    assert cheap not in fetched, "spam task's input was fetched"
+    assert node.metrics.tasks_unprofitable == 1
+    rich = _submit(eng, mid, {"prompt": "ok", "negative_prompt": ""},
+                   fee=100 * WAD)
+    drain(node)
+    assert rich in fetched
+    assert bytes.fromhex(rich[2:]) in eng.solutions
+    ev = node.obs.journal.events(kind="gate_decision")
+    per_task = [e["taskid"] for e in ev]
+    assert per_task.count(cheap) == 1 and per_task.count(rich) == 1
+    node.close()
+
+
+def test_prefloor_is_conservative_under_learned_rows():
+    """Under costsched the pre-floor uses the CHEAPEST predictable
+    cost, so a task below its own bucket's learned cost but above the
+    cheapest bucket's still reaches the precise per-bucket gate (and
+    is rejected there, with the learned evidence)."""
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner())],
+                                  sched=SCHED_ON, min_fee_per_second=WAD,
+                                  assumed_solve_seconds=2.0)
+    defaults = {"num_inference_steps": 20,
+                "scheduler": "DPMSolverMultistep"}
+    _prime(node, mid, {"width": 256, "height": 256, **defaults}, 1.0)
+    _prime(node, mid, {"width": 512, "height": 512, **defaults}, 9.0)
+    # 5 WAD: above the cheap bucket's 1 s floor (pre-floor passes),
+    # below the 512² bucket's learned 9 s cost (precise gate rejects)
+    tid = _submit(eng, mid, {"prompt": "mid", "negative_prompt": "",
+                             "width": 512, "height": 512}, fee=5 * WAD)
+    drain(node)
+    assert bytes.fromhex(tid[2:]) not in eng.solutions
+    ev = node.obs.journal.events(kind="gate_decision")
+    assert ev[-1]["taskid"] == tid
+    assert ev[-1]["verdict"] == "reject"
+    assert ev[-1]["source"] == "cost_model"
+    assert ev[-1]["predicted_seconds"] == 9.0
+    node.close()
+
+
+def test_unprofitable_counter_gains_model_label():
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner())],
+                                  min_fee_per_second=WAD,
+                                  assumed_solve_seconds=10.0)
+    _submit(eng, mid, {"prompt": "cheap", "negative_prompt": ""}, fee=0)
+    drain(node)
+    c = node.obs.registry.counter("arbius_tasks_unprofitable_total",
+                                  labelnames=("model",))
+    assert c.value(model=mid) == 1
+    # back-compat attribute sums the labeled children
+    assert node.metrics.tasks_unprofitable == 1
+    # and the rejection is journaled with the pricing evidence
+    ev = node.obs.journal.events(kind="gate_decision")
+    assert ev[-1]["verdict"] == "reject" and ev[-1]["model"] == mid
+    assert ev[-1]["fee"] == "0"
+    node.close()
+
+
+# -- the learned fit --------------------------------------------------------
+
+def test_seeded_fit_is_deterministic_and_robust():
+    vals = [1.0, 1.1, 0.9, 1.05, 50.0]  # one straggler
+    a = seeded_fit(vals, ("m", "b", "l"))
+    assert a == seeded_fit(list(vals), ("m", "b", "l"))
+    assert 0.9 <= a <= 1.1  # median, not mean
+    big = [float(i % 17) for i in range(500)]
+    assert seeded_fit(big, ("k",)) == seeded_fit(list(big), ("k",))
+    # subsample keys matter (different seeds stream), values still sane
+    assert 0.0 <= seeded_fit(big, ("other",)) <= 16.0
+
+
+def test_cost_tag_roundtrip_and_ingest():
+    key = ("0xmm", 512, 512, 20, "DDIM", None)
+    tag = make_cost_tag(key[0], bucket_str(key), "single", 4)
+    assert parse_cost_tag(tag) == ("0xmm", "512x512.s20.DDIM.f-",
+                                   "single", 4)
+    assert parse_cost_tag(None) is None
+    assert parse_cost_tag("0xtask") is None
+    assert parse_cost_tag("a|b|c|nx") is None
+    m = CostModel(min_samples=2)
+    assert m.ingest_samples([(tag, 8.0), (tag, 12.0), (None, 3.0),
+                             ("garbage", 1.0)]) == 2
+    m.refit(now=5)
+    # 8s and 12s over 4 tasks each → 2.0 and 3.0 per task → median 2.5
+    assert m.predict("0xmm", "512x512.s20.DDIM.f-", "single") == 2.5
+    assert m.predict("0xmm", "512x512.s20.DDIM.f-", "dp2") is None
+    snap = m.snapshot()
+    assert snap["rows"][0]["samples"] == 2
+    assert snap["rows"][0]["updated"] == 5
+
+
+def test_cost_model_persists_across_node_lives(tmp_path):
+    db_path = str(tmp_path / "node.sqlite")
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner())],
+                                  sched=SCHED_ON, db_path=db_path)
+    for i in range(node.costmodel.min_samples):
+        _submit(eng, mid, {"prompt": f"t{i}", "negative_prompt": ""},
+                fee=WAD)
+    drain(node)
+    rows = node.db.load_cost_rows()
+    assert rows, "mining must persist fitted cost rows"
+    key = bucket_key(mid, {"width": 768, "height": 768,
+                           "num_inference_steps": 20,
+                           "scheduler": "DDIM"})
+    node.close()
+
+    # a fresh life on the same sqlite file prices immediately
+    m2 = CostModel(min_samples=1)
+    db2 = NodeDB(db_path)
+    assert m2.load(db2) == len(rows)
+    model, bucket, layout = rows[0][0], rows[0][1], rows[0][2]
+    assert m2.predict(model, bucket, layout) == pytest.approx(rows[0][3])
+    db2.close()
+
+
+def test_pipeline_feeds_the_same_cost_signal(tmp_path):
+    """Cost rows accrue under the staged executor too — the tag rides
+    the per-bucket infer observation both schedules share."""
+    from arbius_tpu.node.config import PipelineConfig
+
+    eng, node, (mid,), _ = _world(
+        [("anythingv3", _ImageFakeRunner())], sched=SCHED_ON,
+        canonical_batch=2,
+        pipeline=PipelineConfig(enabled=True, depth=2, encode_workers=2,
+                                max_inflight_pins=2))
+    for i in range(4):
+        _submit(eng, mid, {"prompt": f"t{i}", "negative_prompt": ""},
+                fee=WAD)
+    drain(node)
+    rows = node.costmodel.sorted_rows()
+    assert rows and rows[0].model == mid
+    assert rows[0].bucket == "768x768.s20.DPMSolverMultistep.f-"
+    node.close()
+
+
+# -- chunk_items edge cases (satellite) -------------------------------------
+
+def test_chunk_items_empty_list():
+    assert chunk_items([], 4) == []
+
+
+def test_chunk_items_bucket_smaller_than_canonical_batch():
+    items = [({"p": 1}, 11)]
+    chunks = chunk_items(items, 4)
+    assert len(chunks) == 1
+    padded, real = chunks[0]
+    assert real == 1 and len(padded) == 4
+    assert padded == [({"p": 1}, 11)] * 4  # pad repeats the last real
+
+
+def test_chunk_items_padding_repeat_correctness():
+    items = [({"p": i}, i) for i in range(6)]
+    chunks = chunk_items(items, 4)
+    assert [real for _, real in chunks] == [4, 2]
+    full, tail = chunks[0][0], chunks[1][0]
+    assert full == items[:4]
+    assert tail[:2] == items[4:6]
+    assert tail[2:] == [items[5], items[5]]  # repeats the FINAL real item
+    # exact multiple: no padding at all
+    chunks = chunk_items(items[:4], 2)
+    assert all(len(p) == 2 and r == 2 for p, r in chunks)
+
+
+# -- jit-cache metrics (satellite) ------------------------------------------
+
+def test_jit_cache_metrics_and_warm_set():
+    import numpy as np
+
+    from arbius_tpu.obs import Obs, use_obs
+    from arbius_tpu.parallel.meshsolve import ShardedImageProbe
+
+    obs = Obs(journal_capacity=64)
+    probe = ShardedImageProbe()
+    items = [({"prompt": "x"}, 7), ({"prompt": "y"}, 8)]
+    with use_obs(obs):
+        np.asarray(probe.dispatch(items))   # cold: miss + compile sample
+        np.asarray(probe.dispatch(items))   # warm: hit
+    reg = obs.registry
+    assert reg.counter("arbius_jit_cache_misses_total").value() == 1
+    assert reg.counter("arbius_jit_cache_hits_total").value() == 1
+    h = reg.histogram("arbius_compile_seconds")
+    assert h.count() == 1
+    assert h.recent()[0][0] == "meshprobe.img.b2"
+    assert "meshprobe.img.b2" in obs.jit_warm
+
+
+# -- debug surface ----------------------------------------------------------
+
+def test_debug_costmodel_endpoint():
+    from arbius_tpu.node.rpc import ControlRPC
+
+    eng, node, (mid,), _ = _world([("anythingv3", _ImageFakeRunner())],
+                                  sched=SCHED_ON, rpc_port=0)
+    _prime(node, mid, {"width": 512, "height": 512}, 4.0)
+    rpc = ControlRPC(node, port=0)
+    rpc.start()
+    try:
+        code, payload = rpc.debug_view("/debug/costmodel")
+    finally:
+        rpc.stop()
+    assert code == 200
+    assert payload["sched"]["policy"] == "costsched"
+    assert payload["jit_warm"] == sorted(node.obs.jit_warm)
+    assert payload["layout"] == "single"
+    assert payload["cost_model"]["rows"][0]["chip_seconds"] == 4.0
+    json.dumps(payload, sort_keys=True)  # JSON-able end to end
+    node.close()
+
+
+# -- config surface ---------------------------------------------------------
+
+def test_sched_config_loads_and_validates():
+    cfg = load_config({"sched": {"enabled": True, "min_samples": 4,
+                                 "warm_boost": 2.0}})
+    assert cfg.sched.enabled and cfg.sched.min_samples == 4
+    assert not load_config({}).sched.enabled  # default: FIFO
+    with pytest.raises(ConfigError, match="min_samples"):
+        load_config({"sched": {"min_samples": 0}})
+    with pytest.raises(ConfigError, match="warm_boost"):
+        load_config({"sched": {"warm_boost": 0.5}})
+
+
+# -- the costmodel CLI (golden-pinned) --------------------------------------
+
+FIXTURES = "tests/fixtures/costmodel"
+
+
+def _run_cli(argv):
+    import io
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import costmodel as cli
+    finally:
+        sys.path.pop(0)
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        rc = cli.main(argv)
+    finally:
+        sys.stdout = old
+    return rc, out.getvalue()
+
+
+def test_costmodel_cli_fit_matches_golden_byte_identical():
+    rc, out = _run_cli(["--fit", f"{FIXTURES}/snapshot.json", "--json",
+                        "--min-samples", "2"])
+    assert rc == 0
+    with open(f"{FIXTURES}/golden_fit.json") as f:
+        assert out == f.read()
+    # run twice: byte-identical (the seeded-fit determinism contract)
+    rc2, out2 = _run_cli(["--fit", f"{FIXTURES}/snapshot.json", "--json",
+                          "--min-samples", "2"])
+    assert out2 == out
+
+
+def test_costmodel_cli_dump_roundtrips_sqlite(tmp_path):
+    db = NodeDB(str(tmp_path / "x.sqlite"))
+    db.upsert_cost_rows([("0xaa", "512x512.s20.DDIM.f-", "single",
+                          3.25, 12, 99)])
+    db.close()
+    rc, out = _run_cli(["--db", str(tmp_path / "x.sqlite"), "--dump",
+                        "--json"])
+    assert rc == 0
+    rows = json.loads(out)["rows"]
+    assert rows == [{"model": "0xaa", "bucket": "512x512.s20.DDIM.f-",
+                     "layout": "single", "chip_seconds": 3.25,
+                     "samples": 12, "updated": 99}]
+    rc, txt = _run_cli(["--db", str(tmp_path / "x.sqlite"), "--dump"])
+    assert rc == 0 and "512x512.s20.DDIM.f-" in txt
+
+
+def test_costmodel_cli_usage_errors():
+    rc, _ = _run_cli(["--dump"])          # --dump without --db
+    assert rc == 2
+    rc, _ = _run_cli([])                  # neither mode
+    assert rc == 2
+
+
+# -- simnet: the scheduler under a mixed-family flood -----------------------
+
+def test_simnet_sched_flood_holds_all_invariants():
+    """Acceptance pin: the costsched packer reordering a burst-submitted
+    two-family flood (varied shapes + fees, latency + slow-runner
+    faults) keeps SIM101-109 green and every task claimed."""
+    from arbius_tpu.sim.harness import run_scenario
+    from arbius_tpu.sim.invariants import check_all, classify_tasks
+    from arbius_tpu.sim.scenario import get_scenario
+
+    result = run_scenario(get_scenario("sched-flood"), 1)
+    findings = check_all(result)
+    assert not findings, "\n".join(f.text() for f in findings)
+    assert set(classify_tasks(result).values()) == {"claimed"}
+    assert len(result.tasks) == 16

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""costmodel — inspect and re-fit the learned chip-seconds cost table.
+
+The profit scheduler (docs/scheduler.md) prices every task from the
+sqlite `cost_model` table NodeDB persists. This tool reads that table
+and re-runs the deterministic seeded fit offline:
+
+    python tools/costmodel.py --db miner.db --dump          # fitted rows
+    python tools/costmodel.py --db miner.db --dump --json   # same, JSON
+    python tools/costmodel.py --fit snapshot.json           # offline fit
+    python tools/costmodel.py --fit snapshot.json --json
+
+`--fit` consumes a histogram snapshot — the stage=infer recent window
+as `{"samples": [["<cost tag>", seconds], ...]}` (the format
+`GET /metrics`' histogram recent windows dump to, and what
+tests/fixtures/costmodel/ pins) — and prints the rows the node would
+fit from it. The fit is seeded and deterministic
+(arbius_tpu/node/costmodel.py), so output is byte-identical for a
+fixed snapshot; tier-1 pins it against a golden fixture.
+
+Exit codes follow the shared tool contract: 0 on success, 2 on usage
+errors (tools/_common.py).
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from _common import EXIT_CLEAN, EXIT_USAGE, kv_table, make_parser
+
+from arbius_tpu.node.costmodel import CostModel  # noqa: E402 (_common fixes path)
+
+
+def render_rows(rows: list[dict]) -> str:
+    """Fixed-format deterministic table, one line per fitted row."""
+    if not rows:
+        return "(no fitted rows)"
+    head = {"model": "model", "bucket": "bucket", "layout": "layout",
+            "chip_seconds": "chip_seconds", "samples": "samples",
+            "updated": "updated"}
+    cols = ["model", "bucket", "layout", "chip_seconds", "samples",
+            "updated"]
+
+    def cell(row, c):
+        v = row[c]
+        return f"{v:.6g}" if isinstance(v, float) else str(v)
+
+    widths = {c: max(len(head[c]), *(len(cell(r, c)) for r in rows))
+              for c in cols}
+    lines = ["  ".join(head[c].ljust(widths[c]) for c in cols)]
+    for r in rows:
+        lines.append("  ".join(cell(r, c).ljust(widths[c]) for c in cols))
+    return "\n".join(ln.rstrip() for ln in lines)
+
+
+def load_db_rows(db_path: str) -> list[dict]:
+    from arbius_tpu.node.costmodel import CostRow
+    from arbius_tpu.node.db import NodeDB
+
+    db = NodeDB(db_path)
+    try:
+        return [CostRow(m, b, l, cs, n, up).to_json()
+                for m, b, l, cs, n, up in db.load_cost_rows()]
+    finally:
+        db.close()
+
+
+def fit_snapshot(path: str, min_samples: int) -> dict:
+    """Offline deterministic fit over a histogram snapshot file."""
+    with open(path) as f:
+        snap = json.load(f)
+    model = CostModel(min_samples=min_samples)
+    parsed = model.ingest_samples(
+        [(tag, float(v)) for tag, v in snap.get("samples", [])])
+    model.refit(now=int(snap.get("now", 0)))
+    out = model.snapshot()
+    out["ingested"] = parsed
+    return out
+
+
+def main(argv=None) -> int:
+    p = make_parser("costmodel", __doc__)
+    p.add_argument("--db", help="node sqlite db holding the cost_model "
+                               "table (for --dump)")
+    p.add_argument("--dump", action="store_true",
+                   help="print the persisted fitted rows")
+    p.add_argument("--fit", metavar="SNAPSHOT",
+                   help="re-run the deterministic fit over a histogram "
+                        "snapshot JSON file")
+    p.add_argument("--min-samples", type=int, default=8,
+                   help="min samples before a row predicts (--fit)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    ns = p.parse_args(argv)
+
+    if bool(ns.dump) == bool(ns.fit):
+        print("exactly one of --dump or --fit is required", file=sys.stderr)
+        return EXIT_USAGE
+    if ns.dump:
+        if not ns.db:
+            print("--dump needs --db <node.sqlite>", file=sys.stderr)
+            return EXIT_USAGE
+        rows = load_db_rows(ns.db)
+        if ns.json:
+            print(json.dumps({"rows": rows}, sort_keys=True, indent=1))
+        else:
+            print(render_rows(rows))
+        return EXIT_CLEAN
+
+    out = fit_snapshot(ns.fit, ns.min_samples)
+    if ns.json:
+        print(json.dumps(out, sort_keys=True, indent=1))
+    else:
+        print(render_rows(out["rows"]))
+        print("\n" + kv_table({"ingested": out["ingested"],
+                               "min_samples": out["min_samples"]}),
+              file=sys.stderr)
+    return EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())
