@@ -1,0 +1,106 @@
+"""arbius_tpu.analysis.conc — "conclint", the whole-node race auditor.
+
+detlint (CONC301/302) checks concurrency *patterns* one file at a
+time; this package audits the node as the multi-threaded system it
+actually is. It reconstructs the **thread topology** — tick loop,
+solvepipe encode workers and condition waiters, the ControlRPC
+serve_forever/request-handler pool, the session daemons, Timer and
+Thread-subclass spawns — by resolving spawns through the import graph,
+infers **locksets** interprocedurally (`with lock:` scopes plus
+acquire/release spans, intersected over every call path), and emits
+the CONC4xx rule family over shared-attribute access sets, the lock
+acquisition graph, blocking calls, and the sqlite/checkpoint write
+discipline (docs/concurrency.md has the catalog and the topology
+diagram).
+
+The static pass is paired with a runtime **witness**
+(`analysis.conc.witness`): instrumented lock wrappers and sampled
+shared-attribute access records that run under the simnet scenario
+matrix, build the *observed* lock-order graph, and cross-confirm or
+downgrade static findings; simnet's SIM110 invariant audits the
+witness record (no runtime lock-order cycle, no unwitnessed-lock write
+to a CONC401-flagged attribute).
+
+Escape hatches are detlint's own: `# detlint: allow[CONC401] reason`
+pragmas, `enforce[...]`, and a snippet-keyed `conclint-baseline.json`.
+CLI: `python -m arbius_tpu.analysis.conc` or `tools/conclint.py`
+(exit 0 clean / 1 findings / 2 usage — the shared lint contract).
+"""
+from __future__ import annotations
+
+import os
+import tokenize
+
+from arbius_tpu.analysis.core import (
+    AnalysisError,
+    Finding,
+    iter_python_files,
+)
+from arbius_tpu.analysis.conc.facts import Program
+from arbius_tpu.analysis.conc.rules import CONC_RULE_IDS, CONC_RULES
+
+
+def findings_from_program(prog: Program,
+                          select: set[str] | None = None
+                          ) -> list[Finding]:
+    """Run every (selected) CONC4xx rule over an assembled Program and
+    apply the per-file pragma/enforce directives."""
+    findings: list[Finding] = []
+    for rid in sorted(CONC_RULES):
+        if select is not None and rid not in select:
+            continue
+        r = CONC_RULES[rid]
+        for path, line, col, message in r.check(prog):
+            ff = prog.files.get(path)
+            if ff is None:
+                continue
+            directives = ff.ctx.directives
+            enforced = rid in directives.enforced
+            if not enforced and directives.is_allowed(rid, line):
+                continue
+            findings.append(Finding(
+                path=path, line=line, col=col, rule=rid,
+                severity=r.severity, message=message,
+                snippet=ff.ctx.snippet(line), enforced=enforced))
+    findings.sort()
+    return findings
+
+
+def analyze_conc_sources(sources: dict[str, str],
+                         select: set[str] | None = None
+                         ) -> tuple[list[Finding], Program]:
+    """In-memory entry point (tests, injected-code regressions):
+    `sources` maps relpath -> source text."""
+    try:
+        prog = Program.build(sources)
+    except SyntaxError as e:
+        raise AnalysisError(f"syntax error: {e}") from e
+    return findings_from_program(prog, select), prog
+
+
+def analyze_conc_tree(paths: list[str], root: str | None = None,
+                      select: set[str] | None = None
+                      ) -> tuple[list[Finding], set[str], Program]:
+    """Analyze every .py under `paths` as ONE program (the
+    interprocedural pass needs the whole tree at once, unlike
+    detlint's per-file driver). Returns (findings, analyzed relpaths,
+    the Program for callers that want the topology)."""
+    root = os.path.abspath(root or os.getcwd())
+    sources: dict[str, str] = {}
+    for abspath, relpath in iter_python_files(paths, root):
+        try:
+            with tokenize.open(abspath) as fh:
+                sources[relpath] = fh.read()
+        except (OSError, UnicodeDecodeError, SyntaxError) as e:
+            raise AnalysisError(f"{relpath}: unreadable: {e}") from e
+    try:
+        prog = Program.build(sources)
+    except SyntaxError as e:
+        raise AnalysisError(f"syntax error: {e}") from e
+    return findings_from_program(prog, select), set(sources), prog
+
+
+__all__ = [
+    "CONC_RULES", "CONC_RULE_IDS", "Program", "analyze_conc_sources",
+    "analyze_conc_tree", "findings_from_program",
+]

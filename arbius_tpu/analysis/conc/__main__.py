@@ -1,0 +1,5 @@
+import sys
+
+from arbius_tpu.analysis.conc.cli import main
+
+sys.exit(main())

@@ -1,0 +1,153 @@
+"""conclint command line — `python -m arbius_tpu.analysis.conc` /
+tools/conclint.py.
+
+Same contract as detlint/graphlint (arbius_tpu.analysis.cli defines it
+once):
+
+    0   clean (every finding fixed, pragma'd, or baselined)
+    1   findings
+    2   usage error (bad path, unknown rule, unreadable baseline)
+
+The baseline is conclint's own file (`conclint-baseline.json`) with
+detlint's exact machinery: snippet-keyed entries, reason-mandatory,
+deterministic `--baseline-update`, `enforce[]`d findings never
+absorbed.
+
+`--witness-report FILE` folds a simnet runtime-witness report
+(analysis.conc.witness) into the output: CONC401 findings whose
+attribute the witness observed racing get a `[witness: confirmed]`
+suffix, ones it never saw contested get `[witness: unwitnessed]` —
+the message changes, the baseline key (path, rule, snippet) does not.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from arbius_tpu.analysis import baseline as baseline_mod
+from arbius_tpu.analysis.cli import (
+    EXIT_CLEAN,
+    EXIT_FINDINGS,
+    EXIT_USAGE,
+    cli_entry,
+    render_json,
+)
+from arbius_tpu.analysis.conc import analyze_conc_tree
+from arbius_tpu.analysis.conc.rules import CONC_RULES
+from arbius_tpu.analysis.core import AnalysisError
+
+DEFAULT_BASELINE = "conclint-baseline.json"
+
+
+def build_arg_parser(p: argparse.ArgumentParser | None = None
+                     ) -> argparse.ArgumentParser:
+    if p is None:
+        p = argparse.ArgumentParser(
+            prog="conclint", description=__doc__,
+            formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*", default=["arbius_tpu"],
+                   help="files/directories to analyze as ONE program "
+                        "(default: arbius_tpu)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (same stable document "
+                        "shape as detlint --json)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help=f"baseline file (default: {DEFAULT_BASELINE}; "
+                        "missing file = empty baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings too")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="rewrite the baseline from the current findings "
+                        "and exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated CONC4xx rule ids to run "
+                        "(default: all)")
+    p.add_argument("--root", default=".",
+                   help="paths in output/baseline are relative to this "
+                        "(default: cwd)")
+    p.add_argument("--witness-report", default=None,
+                   help="simnet witness report JSON: annotate CONC401 "
+                        "findings as confirmed/unwitnessed at runtime")
+    return p
+
+
+def collect(ns: argparse.Namespace):
+    """Analyze per the parsed args and apply the baseline — detlint's
+    collect() shape so tools/conclint.py rides the shared lint_main."""
+    select = None
+    if ns.select:
+        if ns.baseline_update:
+            print("conclint: --baseline-update cannot be combined with "
+                  "--select (it would drop entries for unselected rules)",
+                  file=sys.stderr)
+            return EXIT_USAGE, []
+        select = {r.strip() for r in ns.select.split(",") if r.strip()}
+        unknown = select - set(CONC_RULES)
+        if unknown:
+            print(f"conclint: unknown rule id(s): "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return EXIT_USAGE, []
+    try:
+        findings, analyzed, _prog = analyze_conc_tree(
+            list(ns.paths), root=ns.root, select=select)
+    except AnalysisError as e:
+        print(f"conclint: {e}", file=sys.stderr)
+        return EXIT_USAGE, []
+
+    prev = None
+    try:
+        prev = baseline_mod.Baseline.load(ns.baseline)
+    except FileNotFoundError:
+        prev = None
+    except (OSError, ValueError, KeyError) as e:
+        print(f"conclint: unreadable baseline {ns.baseline}: {e}",
+              file=sys.stderr)
+        return EXIT_USAGE, []
+
+    if ns.baseline_update:
+        baseline_mod.update(findings, prev,
+                            analyzed_paths=analyzed).dump(ns.baseline)
+        kept = [f for f in findings if f.enforced]
+        print(f"conclint: baseline written to {ns.baseline} "
+              f"({len(findings) - len(kept)} finding(s) recorded)",
+              file=sys.stderr)
+        for f in kept:
+            print(f.text() + "  [enforced — cannot be baselined]",
+                  file=sys.stderr)
+        return (EXIT_FINDINGS if kept else EXIT_CLEAN), kept
+
+    if prev is not None and not ns.no_baseline:
+        findings = prev.apply(findings)
+    if ns.witness_report:
+        try:
+            with open(ns.witness_report, encoding="utf-8") as fh:
+                report = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"conclint: unreadable witness report "
+                  f"{ns.witness_report}: {e}", file=sys.stderr)
+            return EXIT_USAGE, []
+        from arbius_tpu.analysis.conc.witness import annotate_findings
+
+        findings = annotate_findings(findings, report)
+    return None, findings
+
+
+def render(ns: argparse.Namespace, findings, out) -> None:
+    """detlint's report format under conclint's name (the JSON document
+    shape is shared byte-for-byte — render_json)."""
+    if ns.json:
+        render_json(findings, out)
+    else:
+        for f in findings:
+            out.write(f.text() + "\n")
+        if findings:
+            out.write(f"conclint: {len(findings)} finding(s)\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    return cli_entry(build_arg_parser, collect, render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1023 @@
+"""conclint fact extraction — the whole-node program model the CONC4xx
+rules audit.
+
+detlint's CONC301/302 are per-file patterns; the races that actually
+threaten the node cross files: a ControlRPC handler thread reading
+state the tick thread mutates, an encode worker touching something the
+condition variable does not guard, a daemon heartbeat writing rows the
+checkpoint owns. This module builds the interprocedural facts those
+audits need, in three layers:
+
+  1. per-file extraction (`_FileFacts`): classes, functions (nested
+     included), attribute-constructor categories (locks / sync
+     primitives / sqlite connections / queues), module-level locks,
+     import aliases, and pragma directives — reusing `core.FileContext`
+     so aliases resolve exactly like every detlint rule;
+  2. iterative body analysis (`Program.build`): a small monomorphic
+     type inference (locals from `Cls()` calls, `self.x = <typed>`
+     attributes, parameters bound when every in-tree call site agrees)
+     run for a few rounds so expression chains like
+     `outer.node.costmodel.rows` resolve to `(MinerNode → CostModel →
+     rows)`; each round re-extracts call sites, attribute accesses
+     (with the lexical lockset held at the site), lock acquisitions,
+     blocking calls, and thread spawns;
+  3. whole-program fixpoints: **thread roots** per function (spawn
+     targets via `threading.Thread(target=…)` / `threading.Timer` /
+     `Thread` subclasses' `run` / `BaseHTTPRequestHandler.do_*`
+     methods, propagated over the call graph; everything reachable
+     from an uncalled entry point runs on the implicit `main` root) and
+     **held locksets** `H(f)` = the intersection over every in-tree
+     call site of (caller's held set ∪ locks lexically held at the
+     call) — so `NodeDB._commit`, called only inside `with self._lock`,
+     is *proved* guarded without a lexical `with` of its own.
+
+Lock identity is name-shaped and intentionally coarse: `Class.attr`
+for `self._lock = threading.Lock()` bindings, `module.NAME` for
+module-level locks. One lock object per (class, attr) is the repo's
+actual discipline; a design with per-instance lock aliasing would need
+a real points-to analysis and is out of scope (docs/concurrency.md
+records the limitation).
+
+Everything is deterministic: files analyzed in sorted order, all
+reported collections sorted, no wall time, no hashing of ids.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from arbius_tpu.analysis.core import FileContext
+from arbius_tpu.analysis.directives import parse_directives
+
+MAIN_ROOT = "main"
+
+# constructor suffixes, canonical-name resolved (same sets CONC301 uses)
+LOCK_SUFFIXES = ("Lock", "RLock", "Condition", "Semaphore",
+                 "BoundedSemaphore")
+SYNC_SUFFIXES = LOCK_SUFFIXES + ("Event", "Barrier", "Thread", "Queue",
+                                 "SimpleQueue", "LifoQueue",
+                                 "PriorityQueue", "local")
+QUEUE_SUFFIXES = ("Queue", "SimpleQueue", "LifoQueue", "PriorityQueue")
+
+# canonical names / prefixes whose call blocks on I/O or time — holding
+# a lock across one of these stalls every sibling of that lock
+BLOCKING_NAMES = frozenset({
+    "time.sleep", "os.fsync", "os.fdatasync", "socket.create_connection",
+    "urllib.request.urlopen", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output", "select.select",
+})
+BLOCKING_PREFIXES = ("socket.", "http.client.", "requests.")
+BLOCKING_METHOD_NAMES = ("serve_forever",)
+
+# SQL verbs that make a sqlite statement a *mutation* (CONC405 cares
+# about daemon threads writing checkpoint state, not reading it)
+_SQL_MUTATORS = ("INSERT", "UPDATE", "DELETE", "REPLACE")
+
+# container methods that mutate their receiver: `self._warm.add(key)`
+# is a WRITE to `_warm` for race purposes (a set growing mid-`sorted()`
+# on another thread raises RuntimeError — the exact race CONC401 hunts)
+_MUTATING_METHODS = frozenset({
+    "add", "append", "appendleft", "extend", "update", "insert",
+    "remove", "discard", "clear", "pop", "popleft", "popitem",
+    "setdefault", "sort", "reverse",
+})
+
+
+def module_of(relpath: str) -> str:
+    """'arbius_tpu/node/db.py' → 'arbius_tpu.node.db';
+    '.../__init__.py' → the package itself."""
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+@dataclass
+class CallSite:
+    callees: tuple[str, ...]      # resolved function ids (may be empty)
+    line: int
+    col: int
+    locks: frozenset              # lock ids lexically held at the call
+
+
+@dataclass
+class Access:
+    owner: str                    # class id the attribute belongs to
+    attr: str
+    kind: str                     # "r" | "w"
+    line: int
+    col: int
+    locks: frozenset              # lexical lockset at the access
+
+
+@dataclass
+class Acquire:
+    lock: str
+    line: int
+    col: int
+    held: frozenset               # locks lexically held OUTSIDE this one
+
+
+@dataclass
+class Blocking:
+    what: str                     # human-readable callee description
+    line: int
+    col: int
+    locks: frozenset              # lexical lockset at the call
+    waits_on: str | None = None   # lock id a cond.wait releases, if any
+
+
+@dataclass
+class Spawn:
+    target: str                   # function id the new thread enters
+    line: int
+    col: int
+    kind: str                     # thread | timer | subclass | handler
+    daemon: bool = False
+    pooled: bool = False          # spawned in a loop / request pool
+
+
+@dataclass
+class FuncFacts:
+    id: str
+    path: str
+    name: str
+    cls: str | None               # owning class id, if a method
+    line: int
+    node: object = field(repr=False, default=None)
+    calls: list = field(default_factory=list)
+    accesses: list = field(default_factory=list)
+    acquires: list = field(default_factory=list)
+    blocking: list = field(default_factory=list)
+    spawns: list = field(default_factory=list)
+    # attrs of the owning class this function reads (CONC405 fence test)
+    self_reads: set = field(default_factory=set)
+
+
+@dataclass
+class ClassFacts:
+    id: str
+    name: str
+    path: str
+    line: int
+    bases: tuple = ()
+    methods: dict = field(default_factory=dict)       # name -> func id
+    lock_attrs: set = field(default_factory=set)      # with-able locks
+    sync_attrs: set = field(default_factory=set)      # any primitive
+    conn_attrs: set = field(default_factory=set)      # sqlite3.connect
+    queue_attrs: set = field(default_factory=set)
+    thread_attrs: set = field(default_factory=set)
+    cond_attrs: set = field(default_factory=set)
+    attr_types: dict = field(default_factory=dict)    # attr -> set(cls)
+    gen_attrs: set = field(default_factory=set)       # += counters
+    mutator_methods: set = field(default_factory=set)  # write sqlite
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.id}.{attr}"
+
+
+class _FileFacts:
+    """One parsed file: the FileContext plus class/function skeletons."""
+
+    def __init__(self, relpath: str, source: str):
+        tree = ast.parse(source)
+        self.ctx = FileContext(relpath, source, tree,
+                               parse_directives(source))
+        self.module = module_of(relpath)
+        self.path = relpath
+
+
+def _ctor_suffix(ctx: FileContext, value: ast.AST) -> str | None:
+    if not isinstance(value, ast.Call):
+        return None
+    name = ctx.canonical(value.func)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+class Program:
+    """The assembled whole-tree model (see module docstring)."""
+
+    def __init__(self):
+        self.files: dict[str, _FileFacts] = {}
+        self.classes: dict[str, ClassFacts] = {}
+        self.functions: dict[str, FuncFacts] = {}
+        self.module_locks: dict[str, set] = {}     # module -> lock names
+        # computed by finalize():
+        self.roots: dict[str, frozenset] = {}
+        self.root_meta: dict[str, dict] = {}
+        self.held: dict[str, frozenset] = {}
+        self.param_types: dict[tuple, set] = {}    # (func id, param) -> cls
+        self.attr_types: dict[tuple, set] = {}     # (cls id, attr) -> cls
+        # `pkg.Name` -> `pkg.module.Name` links from every module's
+        # imports, so package __init__ re-exports resolve to the
+        # DEFINING module (`arbius_tpu.node.MinerNode` chases to
+        # `arbius_tpu.node.node.MinerNode`)
+        self.alias_links: dict[str, str] = {}
+
+    def chase(self, name: str) -> str:
+        seen: set = set()
+        while name in self.alias_links and name not in seen:
+            seen.add(name)
+            name = self.alias_links[name]
+        return name
+
+    # -- assembly ---------------------------------------------------------
+    @classmethod
+    def build(cls, sources: dict[str, str], rounds: int = 3) -> "Program":
+        """`sources` maps relpath -> source text. Deterministic in the
+        mapping contents (iteration is over sorted paths)."""
+        prog = cls()
+        for relpath in sorted(sources):
+            prog._index_file(_FileFacts(relpath, sources[relpath]))
+        for _ in range(max(1, rounds)):
+            changed = prog._analyze_bodies()
+            if not changed:
+                break
+        prog._finalize()
+        return prog
+
+    def _index_file(self, ff: _FileFacts) -> None:
+        self.files[ff.path] = ff
+        ctx = ff.ctx
+        for local, target in ctx.aliases.items():
+            self.alias_links[f"{ff.module}.{local}"] = target
+        # module-level locks
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if value is None or \
+                        _ctor_suffix(ctx, value) not in LOCK_SUFFIXES:
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.setdefault(
+                            ff.module, set()).add(t.id)
+        # classes + functions (nested ones included, qualnames chained)
+        self._index_scope(ff, ctx.tree, ff.module, None)
+
+    def _index_scope(self, ff: _FileFacts, node: ast.AST, prefix: str,
+                     owner: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                cid = f"{prefix}.{child.name}"
+                ctx = ff.ctx
+                bases = tuple(b for b in
+                              (ctx.canonical(x) for x in child.bases) if b)
+                cf = ClassFacts(id=cid, name=child.name, path=ff.path,
+                                line=child.lineno, bases=bases)
+                self.classes[cid] = cf
+                for sub in child.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fid = f"{cid}.{sub.name}"
+                        cf.methods[sub.name] = fid
+                        self.functions[fid] = FuncFacts(
+                            id=fid, path=ff.path, name=sub.name,
+                            cls=cid, line=sub.lineno, node=sub)
+                        self._index_scope(ff, sub, fid, None)
+                    else:
+                        self._index_scope(ff, sub, cid, cid)
+                self._classify_attrs(ff, child, cf)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fid = f"{prefix}.{child.name}"
+                if fid not in self.functions:
+                    self.functions[fid] = FuncFacts(
+                        id=fid, path=ff.path, name=child.name,
+                        cls=owner, line=child.lineno, node=child)
+                self._index_scope(ff, child, fid, None)
+            elif not isinstance(child, (ast.Lambda,)):
+                self._index_scope(ff, child, prefix, owner)
+
+    def _classify_attrs(self, ff: _FileFacts, cls_node: ast.ClassDef,
+                        cf: ClassFacts) -> None:
+        """Categorize `self.x = <ctor>()` attributes and find mutator
+        methods / generation counters."""
+        ctx = ff.ctx
+        for node in ast.walk(cls_node):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if value is None:
+                    continue
+                suffix = _ctor_suffix(ctx, value)
+                canon = ctx.canonical(value.func) \
+                    if isinstance(value, ast.Call) else None
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if suffix in SYNC_SUFFIXES or suffix == "local":
+                        cf.sync_attrs.add(attr)
+                    if suffix in LOCK_SUFFIXES:
+                        cf.lock_attrs.add(attr)
+                    if suffix == "Condition":
+                        cf.cond_attrs.add(attr)
+                    if suffix in QUEUE_SUFFIXES:
+                        cf.queue_attrs.add(attr)
+                    if suffix == "Thread":
+                        cf.thread_attrs.add(attr)
+                    if canon == "sqlite3.connect":
+                        cf.conn_attrs.add(attr)
+            elif isinstance(node, ast.AugAssign):
+                attr = _self_attr(node.target)
+                if attr is not None and isinstance(node.op, ast.Add):
+                    cf.gen_attrs.add(attr)
+
+    # -- body analysis (repeated rounds) ----------------------------------
+    def _analyze_bodies(self) -> bool:
+        """One inference round: re-extract every function's sites with
+        the current type knowledge, then fold new type facts back in.
+        Returns True when a round learned something new."""
+        classes_by_dotted = {c: c for c in self.classes}
+        before = (self._snapshot_types())
+        for fid in sorted(self.functions):
+            fn = self.functions[fid]
+            fn.calls, fn.accesses, fn.acquires = [], [], []
+            fn.blocking, fn.spawns = [], []
+            fn.self_reads = set()
+            _BodyAnalyzer(self, fn, classes_by_dotted).run()
+        self._infer_param_types()
+        return self._snapshot_types() != before
+
+    def _snapshot_types(self):
+        return (
+            {k: frozenset(v) for k, v in self.param_types.items()},
+            {k: frozenset(v) for k, v in self.attr_types.items()},
+        )
+
+    def _infer_param_types(self) -> None:
+        """Bind a parameter to a class when every in-tree call site
+        passes that class (monomorphic-only: a param seeing two
+        different classes stays untyped rather than guessing)."""
+        seen: dict[tuple, set] = {}
+        for fn in self.functions.values():
+            for call in fn.calls:
+                for callee in call.callees:
+                    for (pname, ptypes) in getattr(call, "arg_types", ()):
+                        seen.setdefault((callee, pname),
+                                        set()).update(ptypes)
+        for key, types in seen.items():
+            if types:
+                self.param_types.setdefault(key, set()).update(types)
+
+    # -- finalize: roots + held-lock fixpoints ----------------------------
+    def _finalize(self) -> None:
+        self._compute_roots()
+        self._compute_held()
+        self._compute_mutators()
+
+    def _compute_mutators(self) -> None:
+        """Methods of a sqlite-connection-owning class that WRITE the
+        database (INSERT/UPDATE/DELETE/REPLACE or any executemany) —
+        the 'checkpoint-persisted state' CONC405 polices."""
+        for cf in self.classes.values():
+            if not cf.conn_attrs:
+                continue
+            for name, fid in cf.methods.items():
+                fn = self.functions.get(fid)
+                if fn is None or fn.node is None:
+                    continue
+                for node in ast.walk(fn.node):
+                    if not (isinstance(node, ast.Call) and
+                            isinstance(node.func, ast.Attribute)):
+                        continue
+                    if node.func.attr not in ("execute", "executemany"):
+                        continue
+                    if _self_attr(node.func.value) not in cf.conn_attrs:
+                        continue
+                    if node.func.attr == "executemany":
+                        cf.mutator_methods.add(name)
+                        continue
+                    if node.args and isinstance(node.args[0],
+                                                ast.Constant) and \
+                            isinstance(node.args[0].value, str) and \
+                            node.args[0].value.lstrip().upper().startswith(
+                                _SQL_MUTATORS):
+                        cf.mutator_methods.add(name)
+
+    def _entries(self) -> dict[str, dict]:
+        """root id -> metadata, from every spawn plus HTTP handler and
+        Thread-subclass conventions."""
+        entries: dict[str, dict] = {}
+
+        def add(target: str, kind: str, daemon: bool, pooled: bool):
+            meta = entries.setdefault(
+                target, {"kind": kind, "daemon": False, "pooled": False,
+                         "spawns": 0})
+            meta["daemon"] = meta["daemon"] or daemon
+            meta["spawns"] += 1
+            meta["pooled"] = meta["pooled"] or pooled or meta["spawns"] > 1
+
+        for fn in self.functions.values():
+            for sp in fn.spawns:
+                if sp.target in self.functions:
+                    add(sp.target, sp.kind, sp.daemon, sp.pooled)
+        for cf in self.classes.values():
+            if any(b == "threading.Thread" for b in cf.bases):
+                run = cf.methods.get("run")
+                if run is not None:
+                    add(run, "subclass", _subclass_daemon(self, cf), False)
+            if any(b.endswith("BaseHTTPRequestHandler") for b in cf.bases):
+                for name, fid in sorted(cf.methods.items()):
+                    if name.startswith("do_"):
+                        # one handler thread per request: a pool
+                        add(fid, "handler", True, True)
+        return entries
+
+    def _callees_map(self) -> dict[str, list]:
+        out: dict[str, list] = {}
+        for fn in self.functions.values():
+            edges = out.setdefault(fn.id, [])
+            for call in fn.calls:
+                for callee in call.callees:
+                    if callee in self.functions:
+                        edges.append((callee, call.locks))
+        return out
+
+    def _compute_roots(self) -> None:
+        entries = self._entries()
+        self.root_meta = entries
+        callees = self._callees_map()
+        roots: dict[str, set] = {fid: set() for fid in self.functions}
+        # each spawn root floods its closure
+        for root in sorted(entries):
+            stack, seen = [root], set()
+            while stack:
+                f = stack.pop()
+                if f in seen:
+                    continue
+                seen.add(f)
+                roots[f].add(root)
+                stack.extend(c for c, _ in callees.get(f, ()))
+        # the implicit main root: flood from every function that has no
+        # in-tree caller and is not exclusively a spawn target
+        callers: dict[str, int] = {fid: 0 for fid in self.functions}
+        for f, edges in callees.items():
+            for callee, _ in edges:
+                callers[callee] += 1
+        seeds = [fid for fid in self.functions
+                 if callers[fid] == 0 and fid not in entries]
+        stack, seen = list(seeds), set()
+        while stack:
+            f = stack.pop()
+            if f in seen:
+                continue
+            seen.add(f)
+            roots[f].add(MAIN_ROOT)
+            stack.extend(c for c, _ in callees.get(f, ()))
+        self.roots = {fid: frozenset(r) if r else frozenset((MAIN_ROOT,))
+                      for fid, r in roots.items()}
+
+    def _compute_held(self) -> None:
+        """H(f): locks held at EVERY in-tree call into f (∅ for entry
+        points and uncalled functions). Descending fixpoint from ⊤."""
+        callers: dict[str, list] = {fid: [] for fid in self.functions}
+        for fn in self.functions.values():
+            for call in fn.calls:
+                for callee in call.callees:
+                    if callee in self.functions:
+                        callers[callee].append((fn.id, call.locks))
+        universe = frozenset(self.all_locks())
+        entries = set(self.root_meta)
+        held = {}
+        for fid in self.functions:
+            if fid in entries or not callers[fid]:
+                held[fid] = frozenset()
+            else:
+                held[fid] = universe
+        changed = True
+        while changed:
+            changed = False
+            for fid in sorted(self.functions):
+                if fid in entries or not callers[fid]:
+                    continue
+                new = None
+                for caller, locks in callers[fid]:
+                    site = held[caller] | locks
+                    new = site if new is None else (new & site)
+                new = new if new is not None else frozenset()
+                if new != held[fid]:
+                    held[fid] = new
+                    changed = True
+        self.held = held
+
+    # -- queries ----------------------------------------------------------
+    def all_locks(self) -> set:
+        out = set()
+        for cf in self.classes.values():
+            out.update(cf.lock_id(a) for a in cf.lock_attrs)
+        for mod, names in self.module_locks.items():
+            out.update(f"{mod}.{n}" for n in names)
+        return out
+
+    def lockset(self, fn: FuncFacts, lexical: frozenset) -> frozenset:
+        return self.held.get(fn.id, frozenset()) | lexical
+
+    def func_roots(self, fid: str) -> frozenset:
+        return self.roots.get(fid, frozenset((MAIN_ROOT,)))
+
+    def class_of_method(self, fid: str) -> ClassFacts | None:
+        fn = self.functions.get(fid)
+        if fn is None or fn.cls is None:
+            return None
+        return self.classes.get(fn.cls)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _subclass_daemon(prog: Program, cf: ClassFacts) -> bool:
+    """True when the Thread subclass marks itself daemon (ctor kwarg in
+    a super().__init__ call or a `self.daemon = True` assignment)."""
+    init = cf.methods.get("__init__")
+    fn = prog.functions.get(init) if init else None
+    if fn is None or fn.node is None:
+        return False
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "daemon" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is True:
+                    return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if _self_attr(t) == "daemon" and \
+                        isinstance(node.value, ast.Constant) and \
+                        node.value.value is True:
+                    return True
+    return False
+
+
+class _BodyAnalyzer:
+    """One function body, one round: resolve names/attrs against the
+    program's current type knowledge and record call/access/lock/
+    blocking/spawn sites with the lexical lockset at each."""
+
+    def __init__(self, prog: Program, fn: FuncFacts, classes_by_dotted):
+        self.prog = prog
+        self.fn = fn
+        self.ff = prog.files[fn.path]
+        self.ctx = self.ff.ctx
+        self.classes_by_dotted = classes_by_dotted
+        self.locals: dict[str, set] = {}
+        cf = prog.classes.get(fn.cls) if fn.cls else None
+        if cf is not None and fn.node is not None and fn.node.args.args:
+            first = fn.node.args.args[0].arg
+            if first == "self":
+                self.locals[first] = {cf.id}
+        # typed parameters learned from earlier rounds
+        if fn.node is not None:
+            for a in fn.node.args.args + fn.node.args.kwonlyargs:
+                types = prog.param_types.get((fn.id, a.arg))
+                if types:
+                    self.locals.setdefault(a.arg, set()).update(types)
+
+    # -- type resolution --------------------------------------------------
+    def expr_types(self, node: ast.AST) -> set:
+        """Class ids `node` may evaluate to (empty = unknown)."""
+        if isinstance(node, ast.Name):
+            types = self.locals.get(node.id)
+            if types:
+                return set(types)
+            return self._closure_types(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.expr_types(node.value)
+            out = set()
+            for cid in base:
+                out.update(self.prog.attr_types.get((cid, node.attr), ()))
+            return out
+        if isinstance(node, ast.Call):
+            cid = self.resolve_class(self.ctx.canonical(node.func))
+            return {cid} if cid else set()
+        if isinstance(node, (ast.BoolOp, ast.IfExp)):
+            out = set()
+            for sub in ast.walk(node):
+                if sub is not node and isinstance(
+                        sub, (ast.Call, ast.Name, ast.Attribute)):
+                    out.update(self.expr_types(sub))
+            return out
+        return set()
+
+    def resolve_class(self, canon: str | None) -> str | None:
+        """A canonical dotted name → a tree class id: already-qualified
+        imports hit directly; a bare in-module name gets the module (or
+        the enclosing function/class scope) prefixed."""
+        if not canon:
+            return None
+        for cand in (canon, f"{self.ff.module}.{canon}",
+                     f"{self.fn.id}.{canon}",
+                     f"{self.fn.cls}.{canon}" if self.fn.cls else None):
+            if cand is None:
+                continue
+            cand = self.prog.chase(cand)
+            if cand in self.classes_by_dotted:
+                return cand
+        return None
+
+    def _closure_types(self, name: str) -> set:
+        """A nested scope (the ControlRPC Handler pattern) sees the
+        enclosing functions' local bindings."""
+        node = self.fn.node
+        for anc in self.ctx.ancestors(node) if node is not None else ():
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(anc):
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name) and t.id == name:
+                                # enclosing `outer = self` style binding
+                                enc = self._enclosing_analyzer(anc)
+                                if enc is not None:
+                                    return enc.expr_types(sub.value)
+        return set()
+
+    def _enclosing_analyzer(self, fnode) -> "_BodyAnalyzer | None":
+        for fid, fn in self.prog.functions.items():
+            if fn.node is fnode:
+                return _BodyAnalyzer(self.prog, fn, self.classes_by_dotted)
+        return None
+
+    # -- lock resolution --------------------------------------------------
+    def lock_name(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Call):
+            expr = expr.func  # `with lock:` vs `lock.acquire()` callee
+        if isinstance(expr, ast.Name):
+            mod = self.ff.module
+            if expr.id in self.prog.module_locks.get(mod, ()):
+                return f"{mod}.{expr.id}"
+            # module lock imported from another module
+            canon = self.ctx.canonical(expr)
+            if canon and "." in canon:
+                m, _, n = canon.rpartition(".")
+                if n in self.prog.module_locks.get(m, ()):
+                    return canon
+            return None
+        if isinstance(expr, ast.Attribute):
+            for cid in self.expr_types(expr.value):
+                cf = self.prog.classes.get(cid)
+                if cf is not None and expr.attr in cf.lock_attrs:
+                    return cf.lock_id(expr.attr)
+        return None
+
+    # -- the walk ---------------------------------------------------------
+    def run(self) -> None:
+        node = self.fn.node
+        if node is None:
+            return
+        # first pass: local variable types from straight assignments
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and not _inside_nested_def(
+                    self.ctx, sub, node):
+                types = self.expr_types(sub.value)
+                for t in sub.targets:
+                    if isinstance(t, ast.Name) and types:
+                        self.locals.setdefault(t.id, set()).update(types)
+                    attr = _self_attr(t)
+                    if attr is not None and types and self.fn.cls:
+                        self.prog.attr_types.setdefault(
+                            (self.fn.cls, attr), set()).update(types)
+        self.visit_body(list(node.body), frozenset())
+
+    def visit_body(self, stmts: list, held: frozenset) -> None:
+        """Statement-ordered walk so bare `x.acquire()` / `x.release()`
+        statements extend/shrink the running lockset for what follows."""
+        running = set(held)
+        for stmt in stmts:
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                         ast.Call):
+                call = stmt.value
+                fname = call.func
+                if isinstance(fname, ast.Attribute):
+                    lock = self.lock_name(fname.value)
+                    if lock is not None and fname.attr == "acquire":
+                        self.fn.acquires.append(Acquire(
+                            lock=lock, line=stmt.lineno,
+                            col=stmt.col_offset,
+                            held=frozenset(running)))
+                        self.visit_expr(call, frozenset(running))
+                        running.add(lock)
+                        continue
+                    if lock is not None and fname.attr == "release":
+                        self.visit_expr(call, frozenset(running))
+                        running.discard(lock)
+                        continue
+            self.visit_stmt(stmt, frozenset(running))
+
+    def visit_stmt(self, stmt: ast.AST, held: frozenset) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate function/class: analyzed on its own
+        if isinstance(stmt, ast.With):
+            inner = set(held)
+            for item in stmt.items:
+                self.visit_expr(item.context_expr, held)
+                lock = self.lock_name(item.context_expr)
+                if lock is not None:
+                    self.fn.acquires.append(Acquire(
+                        lock=lock, line=item.context_expr.lineno,
+                        col=item.context_expr.col_offset,
+                        held=frozenset(inner)))
+                    inner.add(lock)
+            self.visit_body(list(stmt.body), frozenset(inner))
+            return
+        # compound statements: recurse into child statement lists with
+        # the same lockset, and visit bare expressions
+        for name in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, name, None)
+            if isinstance(sub, list) and sub and \
+                    isinstance(sub[0], ast.stmt):
+                self.visit_body(sub, held)
+        for h in getattr(stmt, "handlers", ()):
+            self.visit_body(list(h.body), held)
+        for fname, value in ast.iter_fields(stmt):
+            if fname in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            for expr in _exprs_of(value):
+                self.visit_expr(expr, held)
+        # writes: assignment targets
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                self.record_access(t, "w", held)
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        self.record_access(el, "w", held)
+                # a subscripted/attr-chained container write is a write
+                # to the container attr: self.rows[k] = v
+                if isinstance(t, ast.Subscript):
+                    self.record_access(t.value, "w", held)
+
+    def visit_expr(self, expr: ast.AST, held: frozenset) -> None:
+        # manual walk: a lambda body runs at CALL time, not here — its
+        # sites must not inherit this statement's lockset
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Call):
+                self.record_call(node, held)
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                self.record_access(node, "r", held)
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- site recorders ---------------------------------------------------
+    def record_access(self, node: ast.AST, kind: str,
+                      held: frozenset) -> None:
+        if not isinstance(node, ast.Attribute):
+            return
+        owners = self.expr_types(node.value)
+        if not owners:
+            return
+        parent = self.ctx.parent(node)
+        for cid in sorted(owners):
+            cf = self.prog.classes.get(cid)
+            if cf is None:
+                continue
+            if kind == "r" and isinstance(parent, ast.Call) and \
+                    parent.func is node and node.attr in cf.methods:
+                continue  # that's a method call, not a data read
+            if kind == "r":
+                # `self._warm.add(k)`: a mutating container method on
+                # the attribute is a WRITE to it
+                grandparent = self.ctx.parent(parent) \
+                    if isinstance(parent, ast.Attribute) else None
+                if isinstance(parent, ast.Attribute) and \
+                        parent.value is node and \
+                        parent.attr in _MUTATING_METHODS and \
+                        isinstance(grandparent, ast.Call) and \
+                        grandparent.func is parent:
+                    kind = "w"
+            self.fn.accesses.append(Access(
+                owner=cid, attr=node.attr, kind=kind,
+                line=node.lineno, col=node.col_offset, locks=held))
+            if cf.id == self.fn.cls and kind == "r":
+                self.fn.self_reads.add(node.attr)
+
+    def record_call(self, call: ast.Call, held: frozenset) -> None:
+        func = call.func
+        canon = self.ctx.canonical(func)
+        # thread spawns
+        self._maybe_spawn(call)
+        # blocking calls
+        self._maybe_blocking(call, canon, held)
+        callees: set[str] = set()
+        ctor = self.resolve_class(canon)
+        if ctor is not None:
+            init = self.prog.classes[ctor].methods.get("__init__")
+            if init:
+                callees.add(init)
+        else:
+            resolved = self._resolve_dotted(canon)
+            if resolved:
+                callees.add(resolved)
+        if isinstance(func, ast.Attribute):
+            for cid in self.expr_types(func.value):
+                cf = self.prog.classes.get(cid)
+                m = cf.methods.get(func.attr) if cf else None
+                if m is None and cf is not None:
+                    m = self._base_method(cf, func.attr)
+                if m is not None:
+                    callees.add(m)
+        site = CallSite(callees=tuple(sorted(callees)), line=call.lineno,
+                        col=call.col_offset, locks=held)
+        # param types the callees receive (positional + keyword)
+        site.arg_types = self._arg_types(call, callees)
+        self.fn.calls.append(site)
+
+    def _base_method(self, cf: ClassFacts, name: str) -> str | None:
+        for base in cf.bases:
+            bc = self.prog.classes.get(base)
+            if bc is not None:
+                if name in bc.methods:
+                    return bc.methods[name]
+                deeper = self._base_method(bc, name)
+                if deeper:
+                    return deeper
+        return None
+
+    def _resolve_dotted(self, canon: str | None) -> str | None:
+        if not canon:
+            return None
+        for cand in (canon, f"{self.fn.id}.{canon}",
+                     f"{self.ff.module}.{canon}",
+                     f"{self.fn.cls}.{canon}" if self.fn.cls else None):
+            if cand is None:
+                continue
+            cand = self.prog.chase(cand)
+            if cand in self.prog.functions:
+                return cand
+        return None
+
+    def _arg_types(self, call: ast.Call, callees: set) -> tuple:
+        """Record (param name, classes) for each resolved callee and
+        fold the bindings straight into the program's param_types (the
+        next inference round sees them)."""
+        out = []
+        for callee in callees:
+            fn = self.prog.functions.get(callee)
+            if fn is None or fn.node is None:
+                continue
+            params = [a.arg for a in fn.node.args.args]
+            offset = 1 if fn.cls is not None and params[:1] == ["self"] \
+                else 0
+            for i, arg in enumerate(call.args):
+                types = self.expr_types(arg)
+                if types and i + offset < len(params):
+                    out.append(((callee, params[i + offset]),
+                                frozenset(types)))
+            for kw in call.keywords:
+                if kw.arg is None:
+                    continue
+                types = self.expr_types(kw.value)
+                if types:
+                    out.append(((callee, kw.arg), frozenset(types)))
+        for key, types in out:
+            self.prog.param_types.setdefault(key, set()).update(types)
+        return tuple((key[1], types) for (key, types) in out)
+
+    def _maybe_spawn(self, call: ast.Call) -> None:
+        # ONE spawn recognizer shared with detlint's CONC301
+        # (rules_concurrency.spawn_target) — the two gates must agree
+        # on what counts as a thread body, or they drift apart
+        from arbius_tpu.analysis.rules_concurrency import spawn_target
+
+        spawned = spawn_target(self.ctx, call)
+        if spawned is None:
+            return
+        target, kind = spawned
+        tid = self._target_id(target)
+        if tid is None:
+            return
+        daemon = any(kw.arg == "daemon" and
+                     isinstance(kw.value, ast.Constant) and
+                     kw.value.value is True for kw in call.keywords)
+        pooled = any(isinstance(a, (ast.For, ast.While, ast.ListComp,
+                                    ast.GeneratorExp))
+                     for a in self.ctx.ancestors(call))
+        self.fn.spawns.append(Spawn(
+            target=tid, line=call.lineno, col=call.col_offset,
+            kind=kind, daemon=daemon, pooled=pooled))
+
+    def _target_id(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            for cid in sorted(self.expr_types(expr.value)):
+                cf = self.prog.classes.get(cid)
+                if cf and expr.attr in cf.methods:
+                    return cf.methods[expr.attr]
+            return None
+        if isinstance(expr, ast.Name):
+            return self._resolve_dotted(self.ctx.canonical(expr))
+        return None
+
+    def _maybe_blocking(self, call: ast.Call, canon: str | None,
+                        held: frozenset) -> None:
+        # recorded regardless of the LEXICAL lockset: the rule decides
+        # with the interprocedural held-set folded in
+        what = None
+        waits_on = None
+        if canon in BLOCKING_NAMES or (
+                canon and canon.startswith(BLOCKING_PREFIXES)):
+            what = canon
+        func = call.func
+        if what is None and isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr in BLOCKING_METHOD_NAMES:
+                what = f"{attr}()"
+            else:
+                base = func.value
+                # typed-attr patterns: queue get/put, thread join,
+                # condition/event wait — flagged only without a timeout
+                kind = self._attr_kind(base)
+                if kind == "queue" and attr in ("get", "put") and \
+                        not _has_timeout(call):
+                    what = f"{attr}() on a bounded queue without timeout"
+                elif kind == "thread" and attr == "join" and \
+                        not _has_timeout(call, positional_ok=True):
+                    what = "join() without timeout"
+                elif kind in ("cond", "event", "lock") and \
+                        attr == "wait" and not _has_timeout(
+                            call, positional_ok=True):
+                    # cv.wait() releases the cv itself — the rule
+                    # exempts it when the cv is the ONLY lock held
+                    what = "wait() without timeout"
+                    waits_on = self.lock_name(base)
+        if what is None:
+            return
+        self.fn.blocking.append(Blocking(
+            what=what, line=call.lineno, col=call.col_offset,
+            locks=held, waits_on=waits_on))
+
+    def _attr_kind(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            for cid in self.expr_types(expr.value):
+                cf = self.prog.classes.get(cid)
+                if cf is None:
+                    continue
+                a = expr.attr
+                if a in cf.queue_attrs:
+                    return "queue"
+                if a in cf.thread_attrs:
+                    return "thread"
+                if a in cf.cond_attrs:
+                    return "cond"
+                if a in cf.lock_attrs:
+                    return "lock"
+                if a in cf.sync_attrs:
+                    return "event"
+        return None
+
+
+def _has_timeout(call: ast.Call, positional_ok: bool = False) -> bool:
+    """True when the call is genuinely bounded: `timeout=None` is the
+    unbounded default spelled out, `block=True` is the indefinitely-
+    blocking value, and `join(None)`/`wait(None)` block forever — none
+    of those may exempt a CONC403 site."""
+    timeout_kw = block_kw = None
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            timeout_kw = kw.value
+        elif kw.arg == "block":
+            block_kw = kw.value
+    if timeout_kw is not None:
+        # timeout wins over block: get(block=True, timeout=5) is bounded
+        return not (isinstance(timeout_kw, ast.Constant) and
+                    timeout_kw.value is None)
+    if block_kw is not None:
+        # block=False means non-blocking; block=True blocks forever
+        return isinstance(block_kw, ast.Constant) and \
+            block_kw.value is False
+    if positional_ok and call.args:
+        a = call.args[0]
+        return not (isinstance(a, ast.Constant) and a.value is None)
+    return False
+
+
+def _exprs_of(value):
+    if isinstance(value, ast.expr):
+        yield value
+    elif isinstance(value, list):
+        for v in value:
+            if isinstance(v, ast.expr):
+                yield v
+
+
+def _inside_nested_def(ctx: FileContext, node: ast.AST,
+                       fnode: ast.AST) -> bool:
+    for anc in ctx.ancestors(node):
+        if anc is fnode:
+            return False
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+            return True
+    return False

@@ -1,0 +1,301 @@
+"""conclint CONC4xx rules — whole-node race audits over the Program facts.
+
+Each rule consumes the assembled `facts.Program` (thread roots,
+interprocedural locksets, typed attribute accesses) and yields findings
+shaped exactly like detlint's: (path, line, col, message), wrapped by
+the driver into `core.Finding` so pragmas, `enforce[]`, the baseline,
+and the JSON report all behave identically.
+
+  CONC401  a class attribute written on one thread root and read or
+           written on another, with disjoint locksets on the two sides
+  CONC402  lock-order inversion: the static acquisition graph (lock A
+           held while B is acquired) contains a cycle
+  CONC403  a blocking call (sleep, fsync, socket/urllib, bounded-queue
+           get/put or join/wait without timeout) while holding a lock
+  CONC404  a sqlite connection attribute used outside its class's
+           guarding lock (the NodeDB `_lock` discipline)
+  CONC405  a daemon-thread function mutating checkpoint-persisted state
+           (sqlite mutator methods, checkpoint saves) without reading a
+           generation fence first
+
+Roots are *potentially concurrent* when they differ, or when they are
+the same pooled root (a worker pool / HTTP handler pool runs several
+instances of itself at once). The implicit `main` root never races
+itself. `__init__` accesses are exempt everywhere — they happen-before
+any `Thread.start()` (the CONC301 argument, applied tree-wide).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from arbius_tpu.analysis.conc.facts import MAIN_ROOT, Program
+
+# rule ids known to the pragma validator even when this package is not
+# imported — mirrored by core.KNOWN_EXTERNAL_RULES (test-pinned)
+CONC_RULE_IDS = ("CONC401", "CONC402", "CONC403", "CONC404", "CONC405")
+
+
+@dataclass
+class ConcRule:
+    id: str
+    severity: str
+    summary: str
+    check: Callable[[Program], Iterable[tuple[int, int, str, str]]]
+
+
+CONC_RULES: dict[str, ConcRule] = {}
+
+
+def conc_rule(rule_id: str, severity: str, summary: str):
+    def deco(fn):
+        CONC_RULES[rule_id] = ConcRule(rule_id, severity, summary, fn)
+        return fn
+
+    return deco
+
+
+def _root_label(root: str) -> str:
+    """Human-readable thread-root name: the spawned function's tail."""
+    if root == MAIN_ROOT:
+        return "main"
+    return root.rsplit(".", 2)[-2] + "." + root.rsplit(".", 1)[-1] \
+        if "." in root else root
+
+
+def _is_init(prog: Program, fn) -> bool:
+    return fn.cls is not None and fn.name == "__init__"
+
+
+def _concurrent(prog: Program, roots_a: frozenset,
+                roots_b: frozenset) -> tuple | None:
+    """A pair of roots that can run at the same time, or None."""
+    for ra in sorted(roots_a):
+        for rb in sorted(roots_b):
+            if ra != rb:
+                return (ra, rb)
+            if ra != MAIN_ROOT and \
+                    prog.root_meta.get(ra, {}).get("pooled"):
+                return (ra, rb)
+    return None
+
+
+@conc_rule("CONC401", "error",
+           "attribute shared across thread roots with disjoint locksets")
+def shared_attr_disjoint_locksets(prog: Program):
+    per: dict[tuple, list] = {}
+    for fid in sorted(prog.functions):
+        fn = prog.functions[fid]
+        for acc in fn.accesses:
+            per.setdefault((acc.owner, acc.attr), []).append((fn, acc))
+    for (cid, attr) in sorted(per):
+        cf = prog.classes.get(cid)
+        if cf is None or attr in cf.sync_attrs:
+            continue
+        live = [(fn, acc) for fn, acc in per[(cid, attr)]
+                if not _is_init(prog, fn)]
+        writes = [(fn, acc) for fn, acc in live if acc.kind == "w"]
+        if not writes:
+            continue  # read-only after __init__: immutable publication
+        reported = False
+        for wfn, wacc in writes:
+            if reported:
+                break
+            wroots = prog.func_roots(wfn.id)
+            wlocks = prog.lockset(wfn, wacc.locks)
+            for ofn, oacc in live:
+                if ofn is wfn and oacc is wacc:
+                    continue
+                pair = _concurrent(prog, wroots, prog.func_roots(ofn.id))
+                if pair is None:
+                    continue
+                olocks = prog.lockset(ofn, oacc.locks)
+                if wlocks & olocks:
+                    continue
+                what = "written" if oacc.kind == "w" else "read"
+                yield (wfn.path, wacc.line, wacc.col,
+                       f"`{cf.name}.{attr}` is written in `{wfn.id}` "
+                       f"(root {_root_label(pair[0])}) and {what} in "
+                       f"`{ofn.id}` (root {_root_label(pair[1])}, "
+                       f"{ofn.path}:{oacc.line}) with no common lock — "
+                       "thread scheduling decides who wins")
+                reported = True
+                break
+
+
+@conc_rule("CONC402", "error",
+           "lock-order inversion in the static acquisition graph")
+def lock_order_inversion(prog: Program):
+    edges: dict[tuple, tuple] = {}
+    for fid in sorted(prog.functions):
+        fn = prog.functions[fid]
+        for acq in fn.acquires:
+            outer = prog.held.get(fn.id, frozenset()) | acq.held
+            for lock in sorted(outer):
+                if lock != acq.lock:
+                    edges.setdefault((lock, acq.lock),
+                                     (fn.path, acq.line, acq.col, fn.id))
+    # strongly connected components of the lock graph (iterative
+    # Tarjan); any SCC with >= 2 locks is an inversion
+    graph: dict[str, list] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list[list] = []
+    counter = [0]
+
+    def strongconnect(v0):
+        work = [(v0, iter(sorted(graph[v0])))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    for comp in sorted(sccs):
+        comp_set = set(comp)
+        sites = sorted((edges[(a, b)], (a, b)) for (a, b) in edges
+                       if a in comp_set and b in comp_set)
+        (path, line, col, fid), _ = sites[0]
+        listing = "; ".join(
+            f"{a} → {b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+            for (a, b) in sorted(
+                (e for e in edges if e[0] in comp_set
+                 and e[1] in comp_set)))
+        yield (path, line, col,
+               f"lock-order inversion across {{{', '.join(comp)}}}: "
+               f"{listing} — two threads taking these in opposite "
+               "order deadlock")
+
+
+@conc_rule("CONC403", "warning",
+           "blocking call while holding a lock")
+def blocking_under_lock(prog: Program):
+    for fid in sorted(prog.functions):
+        fn = prog.functions[fid]
+        for b in fn.blocking:
+            total = prog.held.get(fn.id, frozenset()) | b.locks
+            if b.waits_on is not None:
+                total = total - {b.waits_on}  # wait() releases the cv
+            if not total:
+                continue
+            yield (fn.path, b.line, b.col,
+                   f"blocking `{b.what}` in `{fn.id}` while holding "
+                   f"{{{', '.join(sorted(total))}}} — every thread "
+                   "waiting on these locks stalls for the full call")
+
+
+@conc_rule("CONC404", "error",
+           "sqlite connection used outside its guarding lock")
+def sqlite_outside_lock(prog: Program):
+    for cid in sorted(prog.classes):
+        cf = prog.classes[cid]
+        if not cf.conn_attrs or not cf.lock_attrs:
+            continue
+        lock_ids = {cf.lock_id(a) for a in sorted(cf.lock_attrs)}
+        for fid in sorted(prog.functions):
+            fn = prog.functions[fid]
+            if fn.cls != cid or fn.name == "__init__":
+                continue
+            seen_lines: set = set()
+            for acc in fn.accesses:
+                if acc.owner != cid or acc.attr not in cf.conn_attrs:
+                    continue
+                if acc.line in seen_lines:
+                    continue
+                total = prog.lockset(fn, acc.locks)
+                if total & lock_ids:
+                    continue
+                seen_lines.add(acc.line)
+                yield (fn.path, acc.line, acc.col,
+                       f"`{cf.name}.{acc.attr}` (a check_same_thread="
+                       "False sqlite handle) used in "
+                       f"`{fn.id}` without holding "
+                       f"{{{' or '.join(sorted(lock_ids))}}} — "
+                       "concurrent statement execution on one "
+                       "connection corrupts cursors")
+
+
+@conc_rule("CONC405", "warning",
+           "daemon thread mutates checkpoint-persisted state without "
+           "a generation fence")
+def daemon_checkpoint_mutation(prog: Program):
+    daemon_roots = {r for r, meta in prog.root_meta.items()
+                    if meta.get("daemon")}
+    if not daemon_roots:
+        return
+    for fid in sorted(prog.functions):
+        fn = prog.functions[fid]
+        droots = prog.func_roots(fn.id) & daemon_roots
+        if not droots:
+            continue
+        cf = prog.classes.get(fn.cls) if fn.cls else None
+        gen_attrs: set = set()
+        seen_bases: set = set()
+        stack = [cf] if cf is not None else []
+        while stack:
+            c = stack.pop()
+            if c is None or c.id in seen_bases:
+                continue
+            seen_bases.add(c.id)
+            gen_attrs |= c.gen_attrs
+            stack.extend(prog.classes.get(b) for b in c.bases)
+        if fn.self_reads & gen_attrs:
+            # the function keys its work off a generation counter its
+            # class advances — the solvepipe fence pattern
+            continue
+        for call in fn.calls:
+            for callee in call.callees:
+                target = prog.functions.get(callee)
+                if target is None:
+                    continue
+                tcf = prog.classes.get(target.cls) if target.cls else None
+                is_mutator = (tcf is not None and
+                              target.name in tcf.mutator_methods)
+                is_ckpt = callee.endswith("checkpoint.save_params")
+                if not (is_mutator or is_ckpt):
+                    continue
+                root = sorted(droots)[0]
+                yield (fn.path, call.line, call.col,
+                       f"`{fn.id}` runs on daemon root "
+                       f"{_root_label(root)} and calls `{callee}`, "
+                       "which mutates checkpoint-persisted state — a "
+                       "daemon dies mid-write at process exit; gate "
+                       "the write on a generation fence owned by the "
+                       "main root, or move it there")

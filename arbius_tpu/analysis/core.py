@@ -34,6 +34,14 @@ from arbius_tpu.analysis.directives import FileDirectives, parse_directives
 
 SEVERITIES = ("error", "warning", "info")
 
+# Rule ids owned by sibling analyzers that share the `# detlint:` pragma
+# grammar (conclint's interprocedural CONC4xx family, analysis/conc/) —
+# LINT002 must treat them as known even when that package is not
+# imported, or every conclint waiver would be flagged as a typo here.
+# tests/test_conclint.py pins this set against conc.CONC_RULE_IDS.
+KNOWN_EXTERNAL_RULES = frozenset(
+    ("CONC401", "CONC402", "CONC403", "CONC404", "CONC405"))
+
 
 @dataclass(frozen=True, order=True)
 class Finding:
@@ -279,7 +287,8 @@ def analyze_source(source: str, relpath: str,
                         "`# detlint: allow[RULE] why it is safe`",
                 snippet=ctx.snippet(line)))
     if select is None or "LINT002" in select:
-        known = set(RULES) | {"LINT001", "LINT002", "*"}
+        known = set(RULES) | {"LINT001", "LINT002", "*"} \
+            | KNOWN_EXTERNAL_RULES
         for line, rid in directives.named_rules:
             if rid not in known:
                 findings.append(Finding(

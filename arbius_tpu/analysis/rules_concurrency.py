@@ -8,8 +8,14 @@ a lock is a data race the tests will basically never catch — the GIL
 makes it *rarely* visible, not correct.
 
   CONC301  an attribute is written in one method and accessed from a
-           `threading.Thread(target=self.<m>)` body (or vice versa)
-           with neither side holding a lock
+           thread body (or vice versa) with neither side holding a
+           lock. Thread bodies are recognized in every spelling this
+           repo (and stdlib code generally) uses: `threading.Thread(
+           target=self.<m>)` — keyword or positional target —
+           `threading.Timer(delay, self.<m>)`, and `run()` methods of
+           `threading.Thread` subclasses (the false-negative fix the
+           conclint PR's topology pass motivated: a Timer or subclass
+           spawn is exactly as concurrent as a direct Thread)
   CONC302  a `queue.Queue()` (or Lifo/PriorityQueue) constructed without
            a positive `maxsize` inside `arbius_tpu/node/` — the node's
            stage buffers exist to exert backpressure, and an unbounded
@@ -70,6 +76,32 @@ def _self_attr(node: ast.AST) -> str | None:
     return None
 
 
+def spawn_target(ctx: "FileContext",
+                 call: ast.Call) -> tuple[ast.AST, str] | None:
+    """The callable a thread-spawning call runs on its new thread, and
+    which spelling spawned it: `Thread(target=f)` / `Thread(None, f)`
+    (target is positional arg 1, after `group`) / `Timer(delay, f)` /
+    `Timer(interval=d, function=f)` — canonical-name matched, so
+    aliases can't evade it. THE one recognizer: CONC301 here and
+    conclint's topology pass (analysis/conc/facts.py) both resolve
+    spawns through it, so a new spelling lands in both gates at once."""
+    fname = ctx.canonical(call.func)
+    if fname is None:
+        return None
+    is_thread = fname == "Thread" or fname.endswith("threading.Thread")
+    is_timer = fname == "Timer" or fname.endswith("threading.Timer")
+    if not (is_thread or is_timer):
+        return None
+    kind = "timer" if is_timer else "thread"
+    kwarg = "function" if is_timer else "target"
+    for kw in call.keywords:
+        if kw.arg == kwarg:
+            return kw.value, kind
+    if len(call.args) > 1:
+        return call.args[1], kind
+    return None
+
+
 def _collect_lock_names(ctx: FileContext) -> set[str]:
     """Every name in the file that holds an actual lock: "self.<attr>"
     for attribute assignments, bare names for locals/module globals.
@@ -120,19 +152,19 @@ class _ClassFacts:
         # writes/reads: attr -> list of (method, line, locked)
         self.writes: dict[str, list] = {}
         self.reads: dict[str, list] = {}
+        # a threading.Thread SUBCLASS's run() is a thread body by
+        # definition — Thread.start() calls it on the new thread
+        if "run" in self.methods and any(
+                ctx.canonical(b) == "threading.Thread" for b in cls.bases):
+            self.thread_targets.add("run")
         for mname, m in self.methods.items():
             for node in ast.walk(m):
                 if isinstance(node, ast.Call):
-                    fname = ctx.canonical(node.func)
-                    if fname is not None and (
-                            fname == "Thread"
-                            or fname.endswith("threading.Thread")):
-                        for kw in node.keywords:
-                            if kw.arg != "target":
-                                continue
-                            attr = _self_attr(kw.value)
-                            if attr in self.methods:
-                                self.thread_targets.add(attr)
+                    spawned = spawn_target(ctx, node)
+                    if spawned is not None:
+                        attr = _self_attr(spawned[0])
+                        if attr in self.methods:
+                            self.thread_targets.add(attr)
                     callee = _self_attr(node.func)
                     if callee in self.methods:
                         self.calls[mname].add(callee)

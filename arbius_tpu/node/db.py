@@ -95,6 +95,10 @@ class NodeDB:
         return getattr(self._batch, "depth", 0)
 
     def close(self):
+        # detlint: allow[CONC404] teardown-only: node.close() stops the
+        # encode pool first, and the queue-depth gauge's job_count
+        # tolerates a closed handle (it answers NaN, never crashes a
+        # scrape) — taking _lock here could deadlock a dying tick
         self._conn.close()
 
     def _commit(self) -> None:

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 
 from arbius_tpu.l0.commitment import taskid2seed
@@ -166,8 +167,22 @@ class MinerNode:
         # is bit-for-bit the static path (test-pinned)
         from arbius_tpu.node.costmodel import CostModel
 
+        # guards the scheduler-state surface shared with the ControlRPC
+        # request threads (docs/concurrency.md): the learned cost table,
+        # the packer's warm set + last pack order, and the boot-refined
+        # solve_layout — everything GET /debug/costmodel snapshots while
+        # the tick thread mutates it. Lock order is state_lock → db lock
+        # (the tick's refit persists while holding it); nothing takes
+        # them in reverse (conclint CONC402 audits the claim).
+        self.state_lock = threading.Lock()
         self.costmodel = CostModel(min_samples=config.sched.min_samples)
-        self.costmodel.load(self.db)
+        # no other thread exists yet, so this lock excludes nobody —
+        # it is held so that EVERY call site of costmodel.load() holds
+        # it, which is what proves (to conclint's interprocedural
+        # held-set and to any future mid-life reload caller) that the
+        # rows table is mutated only under the state lock
+        with self.state_lock:
+            self.costmodel.load(self.db)
         from arbius_tpu.node.sched import CostSched, FifoSched
 
         self._sched = CostSched(self, config.sched) \
@@ -208,7 +223,10 @@ class MinerNode:
 
             # cost-model rows are keyed per layout: a relaid-out fleet
             # must not price its buckets from another layout's programs
-            self.solve_layout = mesh_tag(self.mesh)
+            # (under the state lock: an early-started ControlRPC debug
+            # view must never read the tag mid-publication)
+            with self.state_lock:
+                self.solve_layout = mesh_tag(self.mesh)
         from arbius_tpu.node.factory import mesh_contracts
 
         meshsolve.check_mesh_contract(self.mesh,
@@ -619,10 +637,13 @@ class MinerNode:
     def _ingest_costs(self) -> None:
         """Fold the tick's tagged stage=infer observations into the
         cost model, refit, and persist the fitted rows (inside the
-        tick's batch window — no extra fsync)."""
-        if self.costmodel.ingest(self._h_stage):
-            self.costmodel.refit(self.chain.now)
-            self.costmodel.persist(self.db, self.chain.now)
+        tick's batch window — no extra fsync). Holds the state lock:
+        a /debug/costmodel snapshot mid-refit would iterate the rows
+        dict while it grows."""
+        with self.state_lock:
+            if self.costmodel.ingest(self._h_stage):
+                self.costmodel.refit(self.chain.now)
+                self.costmodel.persist(self.db, self.chain.now)
 
     def _process_solve_batch(self, jobs: list[Job]) -> int:
         """Group solve jobs by shape bucket, pack the buckets (FIFO by
@@ -643,10 +664,15 @@ class MinerNode:
             by_bucket.setdefault(
                 bucket_key(job.data["model"], hydrated), []).append(
                 (job, hydrated))
-        packed = self._sched.pack(
-            [(key, entries,
-              self._bucket_fees(entries) if self._sched.wants_fees else 0)
-             for key, entries in by_bucket.items()])
+        # fee SELECTs stay OUTSIDE the state lock (per-task sqlite I/O
+        # must not stall the RPC debug views or the device stage's
+        # mark_warm); only the pack itself reads/writes packer state
+        scored = [(key, entries,
+                   self._bucket_fees(entries) if self._sched.wants_fees
+                   else 0)
+                  for key, entries in by_bucket.items()]
+        with self.state_lock:
+            packed = self._sched.pack(scored)
         try:
             if self._pipeline is not None and not self.config.evilmode:
                 # staged executor (docs/pipeline.md): same buckets, same
@@ -693,7 +719,8 @@ class MinerNode:
             return 0
         # this bucket's executable is compiled now — the packer's
         # warm-preference signal (docs/scheduler.md)
-        self._sched.mark_warm(key)
+        with self.state_lock:
+            self._sched.mark_warm(key)
         # tagged with the cost key so the learned model can attribute
         # the bucket's wall seconds to (model, bucket, layout, n)
         # detlint: allow[DET101] obs stage timing; never reaches solve bytes

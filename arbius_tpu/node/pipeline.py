@@ -298,8 +298,10 @@ class SolvePipeline:
         ch.dev_seconds = time.perf_counter() - t0
         self._h_stage.observe(ch.dev_seconds, stage="device")
         # dispatch succeeded ⇒ the bucket's executable is compiled —
-        # feed the packer's warm-preference set (docs/scheduler.md)
-        self.node._sched.mark_warm(self._bucket_keys[ch.bucket])
+        # feed the packer's warm-preference set (docs/scheduler.md);
+        # state lock: a /debug snapshot may iterate the warm set
+        with self.node.state_lock:
+            self.node._sched.mark_warm(self._bucket_keys[ch.bucket])
         for job, _ in ch.entries:
             self._stage_event(job.data["taskid"], "solve", job.id)
         if self._workers:

@@ -118,6 +118,12 @@ class ControlRPC:
                     return
                 if self.path == "/api/jobs/queue":
                     try:
+                        # detlint: allow[CONC405] operator job injection
+                        # is this endpoint's purpose: NodeDB._lock
+                        # serializes the write and the handler thread's
+                        # commit fsyncs BEFORE the client is acked
+                        # (per-thread batch windows, db.py) — nothing
+                        # is lost if the daemon dies after the ack
                         job_id = outer.node.db.queue_job(
                             body["method"], body.get("data", {}),
                             priority=int(body.get("priority", 0)),
@@ -142,6 +148,9 @@ class ControlRPC:
                     self._send(200, result)
                 elif self.path == "/api/jobs/delete":
                     try:
+                        # detlint: allow[CONC405] operator job deletion,
+                        # same discipline as /api/jobs/queue above:
+                        # lock-guarded, fsynced before the ack
                         outer.node.db.delete_job(int(body["id"]))
                     except (KeyError, ValueError):
                         self._send(400, {"error": "id required"})
@@ -568,19 +577,27 @@ class ControlRPC:
             # the scheduler's whole pricing state in one view
             # (docs/scheduler.md): fitted rows, packer policy + warm
             # set + last pack order, and the static fallback the gate
-            # degrades to
+            # degrades to. Under the node's state lock: this handler
+            # runs on a request thread while the tick thread refits the
+            # cost table and feeds the warm set (docs/concurrency.md —
+            # the CONC401 finding this view used to be).
             cfg = self.node.config
-            return 200, {
-                "cost_model": self.node.costmodel.snapshot(),
-                "sched": self.node._sched.snapshot(),
-                # ground truth for the packer's warm preference: every
-                # executable-cache tag that actually compiled this life
-                # (obs.jit_warm) — audit `sched.warm` against it
-                "jit_warm": sorted(self.node.obs.jit_warm),
-                "layout": self.node.solve_layout,
-                "min_fee_per_second": str(cfg.min_fee_per_second),
-                "static_seconds": self.node._static_solve_seconds(),
-            }
+            with self.node.state_lock:
+                return 200, {
+                    "cost_model": self.node.costmodel.snapshot(),
+                    "sched": self.node._sched.snapshot(),
+                    # ground truth for the packer's warm preference:
+                    # every executable-cache tag that actually compiled
+                    # this life — audit `sched.warm` against it.
+                    # obs.jit_warm is published copy-on-write by
+                    # jit_cache_get (the tick thread never takes this
+                    # lock there), so this read iterates an immutable
+                    # snapshot, not a mutating set
+                    "jit_warm": sorted(self.node.obs.jit_warm),
+                    "layout": self.node.solve_layout,
+                    "min_fee_per_second": str(cfg.min_fee_per_second),
+                    "static_seconds": self.node._static_solve_seconds(),
+                }
         if parts.path == "/debug/trace":
             taskid = (q.get("taskid") or [""])[0]
             if not taskid:

@@ -55,8 +55,12 @@ class Obs:
         # executable-cache tags that built (compiled) under this obs —
         # the per-process warm-set behind the arbius_jit_cache_*
         # counters (jit_cache_get below), served on /debug/costmodel as
-        # ground truth for the packer's warm set (docs/scheduler.md)
-        self.jit_warm: set = set()
+        # ground truth for the packer's warm set (docs/scheduler.md).
+        # Published copy-on-write (see jit_cache_get): the RPC debug
+        # view iterates it from a request thread, and an in-place .add
+        # mid-sorted() raises RuntimeError — frozenset rebinding makes
+        # every reader see an immutable snapshot (docs/concurrency.md)
+        self.jit_warm: frozenset = frozenset()
 
     def span(self, name: str, **attrs):
         if not self.enabled:
@@ -138,7 +142,12 @@ def jit_cache_get(cache: dict, key, build, tag: str | None = None):
         obs.registry.counter("arbius_jit_cache_misses_total",
                              _JIT_MISS_HELP).inc()
         if tag is not None:
-            obs.jit_warm.add(tag)
+            # copy-on-write publish (misses are rare — one per bucket
+            # shape per life): a /debug/costmodel request thread may be
+            # iterating the current snapshot right now, and the GIL
+            # makes the rebind atomic while the old frozenset stays
+            # valid under its feet (docs/concurrency.md)
+            obs.jit_warm = obs.jit_warm | {tag}
     fn = cache[key] = build()
     return fn, False, tag
 

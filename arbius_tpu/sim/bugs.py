@@ -11,6 +11,8 @@ NEVER be reachable from production wiring — only the sim harness's
 # detlint: enforce[DET101,DET102,DET103,DET105]
 from __future__ import annotations
 
+import threading
+
 from arbius_tpu.chain.devnet import DevnetError
 from arbius_tpu.node import MinerNode
 from arbius_tpu.node.chain_client import EngineError
@@ -39,6 +41,45 @@ class DoubleCommitMinerNode(MinerNode):
         super()._commit_reveal(taskid, cid, t_start, **kwargs)
 
 
+class RacyCounterMinerNode(MinerNode):
+    """Bumps an UNLOCKED counter from the tick thread and from its own
+    spawned daemon — one injected bug, two gates that must both fail
+    closed (docs/concurrency.md): conclint's static CONC401 (the
+    regression test strips the waivers below and requires the finding),
+    and SIM110 at runtime (the witness watches `racy_counter` via
+    WITNESS_WATCH_ATTRS and must record lock-free writes from two
+    roots). The counter feeds nothing — CIDs stay byte-identical."""
+
+    WITNESS_WATCH_ATTRS = ("racy_counter",)
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.racy_counter = 0
+        self._racy_stop = threading.Event()
+        self._racy_thread = threading.Thread(
+            target=self._racy_run, daemon=True, name="racy-counter")
+        self._racy_thread.start()
+
+    def _racy_run(self) -> None:
+        while not self._racy_stop.wait(0.0005):
+            # detlint: allow[CONC301,CONC401] deliberate injected race —
+            # regression ammunition; tests strip this waiver and require
+            # the static finding, and the simnet witness must see it
+            self.racy_counter += 1
+
+    def tick(self) -> int:
+        # detlint: allow[CONC301,CONC401] deliberate injected race (the
+        # other side — see _racy_run above)
+        self.racy_counter += 1
+        return super().tick()
+
+    def close(self) -> None:
+        self._racy_stop.set()
+        self._racy_thread.join(timeout=2.0)
+        super().close()
+
+
 INJECTABLE_BUGS = {
     "double-commit": DoubleCommitMinerNode,
+    "racy-counter": RacyCounterMinerNode,
 }

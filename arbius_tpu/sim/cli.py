@@ -44,7 +44,17 @@ def build_arg_parser(p: argparse.ArgumentParser | None = None
                    help="list the scenario catalog and exit")
     p.add_argument("--inject-bug", default=None,
                    help="run with a deliberately broken node (checker "
-                        "regression); known: double-commit")
+                        "regression); known: double-commit, racy-counter")
+    p.add_argument("--witness", action="store_true",
+                   help="instrument the node with the conclint runtime "
+                        "witness (docs/concurrency.md): SIM110 audits "
+                        "the observed lock-order graph and watched-attr "
+                        "writes; implied by --inject-bug racy-counter")
+    p.add_argument("--witness-out", default=None,
+                   help="write the merged witness report (all runs) as "
+                        "JSON — feed it to `conclint --witness-report` "
+                        "to confirm/downgrade static CONC401 findings; "
+                        "implies --witness")
     p.add_argument("--workdir", default=None,
                    help="directory for node sqlite checkpoints (default: "
                         "a temporary directory; crash-restart scenarios "
@@ -100,6 +110,11 @@ def collect(ns: argparse.Namespace):
         return EXIT_USAGE, []
 
     findings = []
+    # racy-counter exists to be caught by the witness's SIM110 —
+    # running it uninstrumented would test nothing
+    witness = ns.witness or ns.witness_out is not None \
+        or ns.inject_bug == "racy-counter"
+    reports = []
     with tempfile.TemporaryDirectory(prefix="simnet-") as tmp:
         workdir = ns.workdir or tmp
         for scenario in scenarios:
@@ -108,7 +123,9 @@ def collect(ns: argparse.Namespace):
                 db_path = os.path.join(
                     workdir, f"{scenario.name}-{seed}.sqlite")
                 result = run_scenario(scenario, seed, db_path=db_path,
-                                      node_cls=node_cls)
+                                      node_cls=node_cls, witness=witness)
+                if result.witness_report is not None:
+                    reports.append(result.witness_report)
                 run_findings = check_all(result)
                 findings.extend(run_findings)
                 summary = summarize(result)
@@ -118,6 +135,16 @@ def collect(ns: argparse.Namespace):
                     print(f"simsoak: {len(run_findings)} invariant "
                           f"violation(s) — reproduce with: {result.repro()}",
                           file=sys.stderr)
+    if ns.witness_out is not None:
+        from arbius_tpu.analysis.conc.witness import merge_reports
+
+        with open(ns.witness_out, "w", encoding="utf-8",
+                  newline="\n") as fh:
+            json.dump(merge_reports(reports), fh, indent=2,
+                      sort_keys=True)
+            fh.write("\n")
+        print(f"simsoak: witness report written to {ns.witness_out}",
+              file=sys.stderr)
     return None, findings
 
 

@@ -115,6 +115,10 @@ class SimResult:
     # the matrix runs the staged solve executor (docs/pipeline.md);
     # SIM109 audits its journaled stage order only when it actually ran
     pipeline_enabled: bool = False
+    # conclint runtime-witness record (docs/concurrency.md): observed
+    # lock-order graph + watched-attr writes; None when the run was not
+    # instrumented — SIM110 audits it only when present
+    witness_report: dict | None = None
 
     def repro(self) -> str:
         return (f"python -m arbius_tpu.sim --scenario "
@@ -127,7 +131,8 @@ class SimHarness:
                  db_path: str = ":memory:",
                  node_cls: type[MinerNode] = MinerNode,
                  pipeline: bool = True,
-                 mesh: dict | None = None):
+                 mesh: dict | None = None,
+                 witness: bool = False):
         if scenario.faults.crash_after_commit is not None \
                 and db_path == ":memory:":
             # a restart from :memory: builds an EMPTY NodeDB — the run
@@ -143,6 +148,16 @@ class SimHarness:
         self.db_path = db_path
         self.node_cls = node_cls
         self.pipeline = pipeline
+        # conclint runtime witness (docs/concurrency.md): instrumented
+        # lock wrappers + watched-attr sampling on every node this
+        # harness spawns. Bookkeeping-only — CIDs must stay
+        # byte-identical to a witness-off run (test-pinned).
+        self.witness = None
+        if witness:
+            from arbius_tpu.analysis.conc.witness import ConcWitness
+
+            self.witness = ConcWitness()
+            self.witness.register_root("tick")
         # mesh scenarios (docs/multichip.md): a `mesh` config swaps the
         # hash-fake FaultyRunner for meshsolve's ShardedImageProbe — a
         # REAL jitted GSPMD program over the forced 8-way CPU devices,
@@ -275,6 +290,12 @@ class SimHarness:
         node = self.node_cls(chain, cfg, registry, db=db, store=None,
                              pinner=SimPinner(self.plane))
         node._retry_sleep = self.clock.sleep
+        if self.witness is not None:
+            from arbius_tpu.analysis.conc.witness import instrument_node
+
+            # before boot/tick: no thread can be inside a wrapped lock
+            # during the swap (the encode pool is parked on its queue)
+            instrument_node(node, self.witness)
         node.boot(skip_self_test=True)
         self.node = node
         self.result.db = db
@@ -367,6 +388,18 @@ class SimHarness:
         return [j for j in jobs if j.method not in _HEARTBEATS]
 
     def run(self) -> SimResult:
+        try:
+            return self._run()
+        finally:
+            # even when a scenario bug/interrupt escapes mid-run: the
+            # class-level __setattr__ watch hook must come off (a stale
+            # hook would double-count the next witness's records) and
+            # whatever was observed rides the result for post-mortems
+            if self.witness is not None:
+                self.result.witness_report = self.witness.report()
+                self.witness.unwatch_all()
+
+    def _run(self) -> SimResult:
         scenario, result = self.scenario, self.result
         with use_obs(self.node.obs):
             self._tick()             # settle the boot-queued stake job
@@ -423,7 +456,8 @@ def run_scenario(scenario: Scenario, seed: int, *,
                  db_path: str = ":memory:",
                  node_cls: type[MinerNode] = MinerNode,
                  pipeline: bool = True,
-                 mesh: dict | None = None) -> SimResult:
+                 mesh: dict | None = None,
+                 witness: bool = False) -> SimResult:
     """Build a world, drive the scenario to quiescence, return the
     auditable result. `node_cls` lets regression tests inject a
     deliberately buggy node (tests/test_sim.py double-commit);
@@ -431,7 +465,9 @@ def run_scenario(scenario: Scenario, seed: int, *,
     the staged executor. `mesh` (e.g. ``{"dp": 2}``) runs the solves as
     real sharded XLA programs on the virtual device mesh via the
     meshsolve image probe; ``{}`` selects the probe with no mesh (the
-    CID-equality baseline for a meshed run)."""
+    CID-equality baseline for a meshed run). `witness=True` instruments
+    the node with the conclint runtime witness and attaches its report
+    to the result for SIM110 (docs/concurrency.md)."""
     return SimHarness(scenario, seed, db_path=db_path,
                       node_cls=node_cls, pipeline=pipeline,
-                      mesh=mesh).run()
+                      mesh=mesh, witness=witness).run()

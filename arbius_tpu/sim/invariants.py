@@ -33,6 +33,14 @@ reproduces it byte-identically.
                                pipeline-enabled run that solved tasks
                                but journaled NO stage events is itself
                                a finding (the executor went unexercised)
+  SIM110  witness discipline   when the conclint runtime witness
+                               instrumented the run (docs/concurrency.md)
+                               its observed lock-order graph holds no
+                               cycle, and no watched (CONC401-flagged)
+                               attribute was written lock-free from two
+                               concurrently-live thread roots — the
+                               injected-race regression in sim/bugs.py
+                               must trip exactly this
 
 The checkers are deliberately redundant with the engine's own reverts
 (defense in depth): their job is to catch a *node* that violates the
@@ -339,6 +347,33 @@ def check_stage_order(result, find) -> None:
              "executor went unexercised")
 
 
+def check_witness(result, find) -> None:
+    """SIM110: audit the conclint runtime-witness record (present only
+    on instrumented runs — harness `witness=True`)."""
+    report = getattr(result, "witness_report", None)
+    if report is None:
+        return
+    from arbius_tpu.analysis.conc.witness import (
+        contested_attrs,
+        order_cycle,
+    )
+
+    cycle = order_cycle(report)
+    if cycle is not None:
+        find("SIM110", None,
+             "runtime lock-order cycle observed: "
+             + " → ".join(cycle)
+             + " — two threads interleaving these acquisitions deadlock")
+    for (cls, attr), entry in sorted(contested_attrs(report).items()):
+        if len(entry["roots"]) >= 2 and entry["lock_free_roots"]:
+            find("SIM110", None,
+                 f"watched attribute `{cls}.{attr}` written with NO "
+                 f"witnessed lock from root(s) "
+                 f"{sorted(entry['lock_free_roots'])} while root(s) "
+                 f"{sorted(entry['roots'])} were writing it — the "
+                 "CONC401 race is live at runtime, not just static")
+
+
 CHECKERS = (
     check_task_conservation,
     check_commit_before_reveal,
@@ -349,6 +384,7 @@ CHECKERS = (
     check_token_conservation,
     check_liveness,
     check_stage_order,
+    check_witness,
 )
 
 

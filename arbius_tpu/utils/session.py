@@ -28,8 +28,9 @@ class Heartbeat:
         self._t.start()
 
     def set(self, phase: str) -> None:
-        # detlint: allow[CONC301] single-writer cosmetic label: the str
-        # publish is GIL-atomic and the reader tolerates staleness
+        # detlint: allow[CONC301,CONC401] single-writer cosmetic label:
+        # the str publish is GIL-atomic and the reader tolerates
+        # staleness
         self.phase = phase
         self._note(f"[{self.stage}] phase: {phase}")
 

@@ -1,0 +1,17 @@
+"""CONC401 positive: attribute shared across roots, no common lock."""
+import threading
+
+
+class Miner:
+    def __init__(self):
+        self.status = "boot"
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def update(self, s):
+        with self._lock:
+            self.status = s        # writer holds the lock...
+
+    def _loop(self):
+        while self.status != "stop":   # ...the thread body does not
+            pass

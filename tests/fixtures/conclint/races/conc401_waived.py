@@ -1,0 +1,17 @@
+"""CONC401 waived: same shape, reviewed and pragma'd."""
+import threading
+
+
+class Gauge:
+    def __init__(self):
+        self.reading = 0.0
+        self._t = threading.Thread(target=self._sample, daemon=True)
+
+    def publish(self, v):
+        # detlint: allow[CONC401] cosmetic telemetry float: GIL-atomic
+        # publish, sampler tolerates staleness
+        self.reading = v
+
+    def _sample(self):
+        while True:
+            print(self.reading)

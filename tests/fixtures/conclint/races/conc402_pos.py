@@ -1,0 +1,17 @@
+"""CONC402 positive: two locks acquired in both orders."""
+import threading
+
+ALPHA = threading.Lock()
+BETA = threading.Lock()
+
+
+def forward():
+    with ALPHA:
+        with BETA:
+            pass
+
+
+def backward():
+    with BETA:
+        with ALPHA:
+            pass

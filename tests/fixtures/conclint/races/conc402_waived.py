@@ -1,0 +1,20 @@
+"""CONC402 waived: a reviewed inversion (single-threaded tool code)."""
+import threading
+
+GAMMA = threading.Lock()
+DELTA = threading.Lock()
+
+
+def one_way():
+    with GAMMA:
+        # detlint: allow[CONC402] both paths run on the one CLI thread
+        # — reviewed: no second thread ever takes these (the finding
+        # anchors at the inversion's first acquisition site)
+        with DELTA:
+            pass
+
+
+def other_way():
+    with DELTA:
+        with GAMMA:
+            pass

@@ -1,0 +1,20 @@
+"""CONC403 positive: blocking calls while holding a lock — lexically
+and through a call chain (the interprocedural half)."""
+import threading
+import time
+
+
+class Pinner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def direct(self):
+        with self._lock:
+            time.sleep(2.0)        # lexical
+
+    def _slow_helper(self):
+        time.sleep(1.0)            # held via every caller
+
+    def indirect(self):
+        with self._lock:
+            self._slow_helper()

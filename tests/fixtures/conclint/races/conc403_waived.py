@@ -1,0 +1,22 @@
+"""CONC403 waived + the wait() exemption."""
+import threading
+import time
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self.items = []
+
+    def flush(self):
+        with self._lock:
+            # detlint: allow[CONC403] intentional: the lock exists to
+            # serialize this one-shot settle; bounded at 50 ms
+            time.sleep(0.05)
+
+    def consume(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait()    # releases the cv: NOT a finding
+            return self.items.pop()

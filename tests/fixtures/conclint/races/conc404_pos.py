@@ -1,0 +1,22 @@
+"""CONC404 positive: sqlite handle used off-lock — plus the
+interprocedurally-proved-clean helper that must NOT fire."""
+import sqlite3
+import threading
+
+
+class Store:
+    def __init__(self, path):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+
+    def put(self, k, v):
+        with self._lock:
+            self._conn.execute("INSERT INTO kv VALUES (?, ?)", (k, v))
+            self._commit()
+
+    def _commit(self):
+        self._conn.commit()        # clean: every caller holds _lock
+
+    def peek(self, k):
+        return self._conn.execute(   # CONC404: no lock on this path
+            "SELECT v FROM kv WHERE k = ?", (k,)).fetchone()

@@ -1,0 +1,18 @@
+"""CONC404 waived: teardown-only handle use."""
+import sqlite3
+import threading
+
+
+class Closer:
+    def __init__(self, path):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+
+    def write(self, v):
+        with self._lock:
+            self._conn.execute("INSERT INTO t VALUES (?)", (v,))
+
+    def close(self):
+        # detlint: allow[CONC404] teardown: callers stop every other
+        # thread first; taking the lock here could deadlock a dying run
+        self._conn.close()

@@ -1,0 +1,46 @@
+"""CONC405 positive: a daemon thread persisting state with no fence —
+next to the fenced variant that must NOT fire."""
+import sqlite3
+import threading
+
+
+class StateDB:
+    def __init__(self, path):
+        self._conn = sqlite3.connect(path)
+        self._lock = threading.Lock()
+
+    def save(self, v):
+        with self._lock:
+            self._conn.execute("UPDATE state SET v = ?", (v,))
+
+
+class UnfencedNode:
+    def __init__(self, db):
+        self.db = db
+        self._t = threading.Thread(target=self._flush, daemon=True)
+
+    def _flush(self):
+        while True:
+            self.db.save(1)        # CONC405: daemon write, no fence
+
+
+class FencedNode:
+    def __init__(self, db):
+        self.db = db
+        self._gen = 0
+        self._t = threading.Thread(target=self._flush, daemon=True)
+
+    def tick(self):
+        # detlint: allow[CONC401] monotonic int fence: GIL-atomic
+        # publish; the daemon only ever compares it
+        self._gen += 1
+
+    def _flush(self):
+        while True:
+            if self._gen > 0:      # generation fence: main advances it
+                self.db.save(self._gen)
+
+
+def build(path):
+    db = StateDB(path)
+    return UnfencedNode(db), FencedNode(db)

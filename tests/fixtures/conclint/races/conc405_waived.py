@@ -1,0 +1,29 @@
+"""CONC405 waived: reviewed operator-surface write from a daemon."""
+import sqlite3
+import threading
+
+
+class OpDB:
+    def __init__(self, path):
+        self._conn = sqlite3.connect(path)
+        self._lock = threading.Lock()
+
+    def enqueue(self, v):
+        with self._lock:
+            self._conn.execute("INSERT INTO jobs VALUES (?)", (v,))
+
+
+class OperatorListener:
+    def __init__(self, db):
+        self.db = db
+        self._t = threading.Thread(target=self._serve, daemon=True)
+
+    def _serve(self):
+        while True:
+            # detlint: allow[CONC405] operator injection endpoint:
+            # lock-guarded, fsynced before the caller is acked
+            self.db.enqueue(1)
+
+
+def build(path):
+    return OperatorListener(OpDB(path))

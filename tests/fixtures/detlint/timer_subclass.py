@@ -1,0 +1,44 @@
+"""CONC301 fixture: the Timer and Thread-subclass spawn spellings the
+rule was blind to before the conclint PR. Both classes share an
+unlocked attribute with their thread body."""
+import threading
+
+
+class TimerRefresher:
+    def __init__(self):
+        self.stale = False
+        self._t = threading.Timer(30.0, self._refresh)
+
+    def mark(self):
+        self.stale = True          # CONC301: races the timer thread
+
+    def _refresh(self):
+        if self.stale:
+            self.stale = False
+
+
+class SubclassWorker(threading.Thread):
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.command = None
+
+    def send(self, cmd):
+        self.command = cmd         # CONC301: races run()
+
+    def run(self):
+        while self.command != "stop":
+            pass
+
+
+class WaivedTimer:
+    def __init__(self):
+        self.label = ""
+        self._t = threading.Timer(5.0, self._tick)
+
+    def set_label(self, s):
+        # detlint: allow[CONC301] cosmetic label, single writer, the
+        # timer thread tolerates staleness
+        self.label = s
+
+    def _tick(self):
+        print(self.label)

@@ -320,6 +320,61 @@ def test_conc301_module_level_lock_recognized():
     assert not check(src)
 
 
+def test_conc301_timer_spawn_recognized():
+    # threading.Timer runs its function on a new thread exactly like
+    # Thread(target=...) — the pre-conclint rule missed it entirely
+    src = _THREADED.replace(
+        "threading.Thread(target=self._run, daemon=True)",
+        "threading.Timer(5.0, self._run)")
+    assert rules_of(check(src)) == ["CONC301"]
+    # keyword form too
+    src = _THREADED.replace(
+        "threading.Thread(target=self._run, daemon=True)",
+        "threading.Timer(interval=5.0, function=self._run)")
+    assert rules_of(check(src)) == ["CONC301"]
+    # aliased import cannot evade (canonical-name matching)
+    src = ("from threading import Timer as _T\n"
+           + _THREADED.replace(
+               "threading.Thread(target=self._run, daemon=True)",
+               "_T(5.0, self._run)"))
+    assert rules_of(check(src)) == ["CONC301"]
+
+
+def test_conc301_positional_thread_target_recognized():
+    # Thread(group, target, ...): target is positional arg 1
+    src = _THREADED.replace(
+        "threading.Thread(target=self._run, daemon=True)",
+        "threading.Thread(None, self._run)")
+    assert rules_of(check(src)) == ["CONC301"]
+
+
+def test_conc301_thread_subclass_run_is_a_target():
+    src = ("import threading\n"
+           "class W(threading.Thread):\n"
+           "    def __init__(self):\n"
+           "        super().__init__(daemon=True)\n"
+           "        self.state = 'idle'\n"
+           "    def poke(self, s):\n"
+           "        self.state = s\n"
+           "    def run(self):\n"
+           "        while self.state != 'stop':\n"
+           "            pass\n")
+    hits = check(src)
+    assert rules_of(hits) == ["CONC301"]
+    assert "self.state" in hits[0].message
+    # a non-Thread base with a run() method is NOT a thread body
+    assert not check(src.replace("class W(threading.Thread):",
+                                 "class W(Base):"))
+
+
+def test_conc301_timer_subclass_fixture_golden_json():
+    got = _json_report([str(FIXDIR / "timer_subclass.py")], str(FIXDIR))
+    want = (FIXDIR / "timer_subclass.golden.json").read_text()
+    assert got == want
+    doc = json.loads(got)
+    assert [f["rule"] for f in doc["findings"]] == ["CONC301"] * 2
+
+
 _NODE_PY = "arbius_tpu/node/somefile.py"   # CONC302 is node/-scoped
 
 

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""conclint — whole-node thread-topology + lockset race audit.
+
+Pre-commit / CI front door for `arbius_tpu.analysis.conc` (the CONC4xx
+rule catalog and the thread-topology model live in
+docs/concurrency.md):
+
+    python tools/conclint.py                      # audit arbius_tpu/
+    python tools/conclint.py --json               # stable JSON report
+    python tools/conclint.py --baseline-update    # regenerate baseline
+    python tools/conclint.py --select CONC401     # one rule
+    python tools/conclint.py --witness-report w.json   # fold in the
+                                                  # simnet runtime witness
+
+Exit codes: 0 clean / 1 findings / 2 usage error — the same lint
+contract detlint.py and graphlint.py ship (tools/_common.py `lint_main`
+is the whole main loop; this file is the same thin shell).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _common import lint_main
+
+from arbius_tpu.analysis.conc.cli import build_arg_parser, collect, render
+
+
+def main(argv=None) -> int:
+    return lint_main("conclint", __doc__, build_arg_parser, collect,
+                     render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
