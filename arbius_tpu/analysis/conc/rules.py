@@ -17,6 +17,15 @@ and the JSON report all behave identically.
   CONC405  a daemon-thread function mutating checkpoint-persisted state
            (sqlite mutator methods, checkpoint saves) without reading a
            generation fence first
+  CONC406  a sqlite database opened in the node/fleet trees without the
+           cross-process lock discipline: every `sqlite3.connect` there
+           must configure `busy_timeout` in the same function (writer
+           contention becomes a bounded wait, not an instant "database
+           is locked"), and handles on the SHARED fleet database
+           (arbius_tpu/fleet/) must additionally enable WAL — several
+           processes hold this file open at once, and a rollback-
+           journal writer would block every reader for the whole
+           transaction (docs/fleet.md, docs/concurrency.md)
 
 Roots are *potentially concurrent* when they differ, or when they are
 the same pooled root (a worker pool / HTTP handler pool runs several
@@ -26,6 +35,7 @@ any `Thread.start()` (the CONC301 argument, applied tree-wide).
 """
 from __future__ import annotations
 
+import ast
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
@@ -33,7 +43,8 @@ from arbius_tpu.analysis.conc.facts import MAIN_ROOT, Program
 
 # rule ids known to the pragma validator even when this package is not
 # imported — mirrored by core.KNOWN_EXTERNAL_RULES (test-pinned)
-CONC_RULE_IDS = ("CONC401", "CONC402", "CONC403", "CONC404", "CONC405")
+CONC_RULE_IDS = ("CONC401", "CONC402", "CONC403", "CONC404", "CONC405",
+                 "CONC406")
 
 
 @dataclass
@@ -250,6 +261,59 @@ def sqlite_outside_lock(prog: Program):
                        f"{{{' or '.join(sorted(lock_ids))}}} — "
                        "concurrent statement execution on one "
                        "connection corrupts cursors")
+
+
+# paths whose sqlite handles live under concurrency: the node db
+# (ControlRPC threads vs the tick) and the fleet's shared lease db
+# (many PROCESSES on one file — the WAL requirement)
+_CONC406_SCOPE = ("arbius_tpu/node/", "arbius_tpu/fleet/")
+_CONC406_SHARED = ("arbius_tpu/fleet/",)
+
+
+@conc_rule("CONC406", "error",
+           "sqlite opened without the cross-process lock discipline "
+           "(busy_timeout; WAL for the shared fleet db)")
+def sqlite_connect_discipline(prog: Program):
+    for fid in sorted(prog.functions):
+        fn = prog.functions[fid]
+        if fn.node is None or \
+                not fn.path.startswith(_CONC406_SCOPE):
+            continue
+        ff = prog.files.get(fn.path)
+        if ff is None:
+            continue
+        connects = [n for n in ast.walk(fn.node)
+                    if isinstance(n, ast.Call)
+                    and ff.ctx.canonical(n.func) == "sqlite3.connect"]
+        if not connects:
+            continue
+        # the discipline must be established where the handle is born:
+        # scan the SAME function for the pragma strings (f-string
+        # constant parts included — busy_timeout is parametrized).
+        # Granularity is per FUNCTION, not per handle: a function
+        # opening two databases with only one disciplined passes —
+        # tying pragmas to individual connection variables needs
+        # dataflow this analyzer does not do (docs/concurrency.md
+        # records the limitation; keep one connect per function)
+        blob = " ".join(
+            c.value for c in ast.walk(fn.node)
+            if isinstance(c, ast.Constant) and isinstance(c.value, str))
+        shared = fn.path.startswith(_CONC406_SHARED)
+        for call in connects:
+            if "busy_timeout" not in blob:
+                yield (fn.path, call.lineno, call.col_offset,
+                       f"`{fn.id}` opens a sqlite database without "
+                       "setting PRAGMA busy_timeout — concurrent "
+                       "writers get an instant 'database is locked' "
+                       "instead of a bounded wait; configure it where "
+                       "the handle is created")
+            elif shared and "journal_mode=WAL" not in blob:
+                yield (fn.path, call.lineno, call.col_offset,
+                       f"`{fn.id}` opens the shared fleet database "
+                       "without PRAGMA journal_mode=WAL — a rollback-"
+                       "journal writer blocks every other process's "
+                       "reads for the whole transaction; the lease "
+                       "plane requires WAL (docs/fleet.md)")
 
 
 @conc_rule("CONC405", "warning",

@@ -40,7 +40,7 @@ SEVERITIES = ("error", "warning", "info")
 # imported, or every conclint waiver would be flagged as a typo here.
 # tests/test_conclint.py pins this set against conc.CONC_RULE_IDS.
 KNOWN_EXTERNAL_RULES = frozenset(
-    ("CONC401", "CONC402", "CONC403", "CONC404", "CONC405"))
+    ("CONC401", "CONC402", "CONC403", "CONC404", "CONC405", "CONC406"))
 
 
 @dataclass(frozen=True, order=True)

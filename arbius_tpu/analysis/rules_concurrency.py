@@ -248,9 +248,11 @@ _QUEUE_CTORS = ("queue.Queue", "queue.LifoQueue", "queue.PriorityQueue")
       "unbounded queue.Queue in node code defeats backpressure")
 def unbounded_queue(ctx: FileContext):
     """Node-scoped by path: the rule is about the miner's stage buffers
-    (arbius_tpu/node/), not about queues in general — tools and tests
-    may buffer freely."""
-    if not ctx.path.startswith("arbius_tpu/node/"):
+    (arbius_tpu/node/) and the fleet's worker-side buffers
+    (arbius_tpu/fleet/ — the 10k flood soak proves the bound holds at
+    load), not about queues in general — tools and tests may buffer
+    freely."""
+    if not ctx.path.startswith(("arbius_tpu/node/", "arbius_tpu/fleet/")):
         return
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):

@@ -72,7 +72,20 @@ ENGINE_EVENTS = {
 
 
 class RpcError(Exception):
-    pass
+    """JSON-RPC failure. When the endpoint answered a structured error
+    object, `code`/`message`/`data` carry its fields; transport-level
+    faults (socket death, timeouts) leave them None. Classifiers
+    (node/rpc_chain._engine_error) must read `message` — the `data`
+    field can echo request payloads (e.g. submitTask input bytes), so
+    substring-scanning the stringified exception would let a task
+    payload impersonate a revert or a nonce conflict."""
+
+    def __init__(self, text: str, *, code: int | None = None,
+                 message: str | None = None, data=None):
+        super().__init__(text)
+        self.code = code
+        self.message = message if message is not None else text
+        self.data = data
 
 
 @dataclass
@@ -91,7 +104,12 @@ class JsonRpcTransport:
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             payload = json.loads(r.read())
         if "error" in payload:
-            raise RpcError(str(payload["error"]))
+            err = payload["error"]
+            if isinstance(err, dict):
+                raise RpcError(str(err), code=err.get("code"),
+                               message=str(err.get("message", "")),
+                               data=err.get("data"))
+            raise RpcError(str(err))
         return payload["result"]
 
 
@@ -103,11 +121,16 @@ class EngineRpcClient:
     """
 
     def __init__(self, transport, engine_address: str, wallet: Wallet,
-                 chain_id: int = ARBITRUM_NOVA_CHAINID):
+                 chain_id: int = ARBITRUM_NOVA_CHAINID, tx_guard=None):
         self.transport = transport
         self.engine_address = engine_address.lower()
         self.wallet = wallet
         self.chain_id = chain_id
+        # fleet shared-wallet seam (docs/fleet.md): a context-manager
+        # factory held across the nonce-read → sign → send window so
+        # several processes sharing one wallet cannot draw the same
+        # nonce. None = no coordination (the single-wallet default).
+        self.tx_guard = tx_guard
 
     # -- reads -----------------------------------------------------------
     def eth_call(self, signature: str, types: list[str], values: list) -> bytes:
@@ -171,10 +194,19 @@ class EngineRpcClient:
     def send_to(self, address: str, signature: str, types: list[str],
                 values: list, *, gas_limit: int = 2_000_000,
                 value: int = 0) -> str:
-        raw = self.sign_call(address, signature, types, values,
-                             gas_limit=gas_limit, value=value)
-        return self.transport.request("eth_sendRawTransaction",
-                                      ["0x" + raw.hex()])
+        if self.tx_guard is None:
+            raw = self.sign_call(address, signature, types, values,
+                                 gas_limit=gas_limit, value=value)
+            return self.transport.request("eth_sendRawTransaction",
+                                          ["0x" + raw.hex()])
+        # shared-wallet mode: the nonce MUST be read inside the guard —
+        # signing outside it and sending inside would still race the
+        # read (two workers sign nonce N, one send reverts)
+        with self.tx_guard():
+            raw = self.sign_call(address, signature, types, values,
+                                 gas_limit=gas_limit, value=value)
+            return self.transport.request("eth_sendRawTransaction",
+                                          ["0x" + raw.hex()])
 
     # -- logs ------------------------------------------------------------
     def get_logs(self, event: str, from_block: int, to_block: int) -> list:

@@ -145,6 +145,63 @@ class SchedConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Multi-process fleet mining (docs/fleet.md): a coordinator owns
+    the chain event stream and leases tasks across N worker processes
+    through a shared sqlite lease table (WAL + busy_timeout); workers
+    are full MinerNodes in worker mode (external task feed, lease
+    heartbeat in the tick, cross-process commit dedupe).
+
+    Disabled by default — `enabled: false` IS the single-node path.
+    A fleet of one worker produces byte-identical CIDs to a bare
+    MinerNode on the same event stream (tests/test_sim.py pins it)."""
+    enabled: bool = False
+    # worker processes the coordinator leases tasks across
+    workers: int = 2
+    # chain-time seconds a lease stays exclusive without a heartbeat;
+    # a dead worker's tasks are stealable after this
+    lease_ttl: int = 60
+    # "per-worker": each worker signs with its own wallet (its own
+    # validator stake). "shared": one wallet, tx signing serialized
+    # through the lease db's wallet guard (nonce-safe, one validator)
+    wallet_mode: str = "per-worker"
+    # shared lease database path (every fleet process opens this file)
+    lease_db: str = "fleet-leases.sqlite"
+    # leases a worker may pull per tick, and the task/solve backlog
+    # bound above which it stops pulling (the CONC302 story at fleet
+    # scale: worker memory stays bounded, the lease table is the
+    # durable overflow buffer)
+    max_leases: int = 4
+    backlog: int = 8
+    # lease (re)deliveries before a task is marked failed fleet-wide
+    # (a poison task must not ping-pong between workers forever)
+    max_attempts: int = 4
+    # sqlite busy_timeout for lease-db handles (milliseconds)
+    busy_timeout_ms: int = 5000
+
+    def __post_init__(self):
+        if self.workers < 1:
+            raise ConfigError("fleet.workers must be >= 1")
+        if self.lease_ttl < 1:
+            raise ConfigError("fleet.lease_ttl must be >= 1 second")
+        if self.wallet_mode not in ("per-worker", "shared"):
+            raise ConfigError(f"unknown fleet.wallet_mode "
+                              f"{self.wallet_mode!r} (per-worker|shared)")
+        if not self.lease_db or self.lease_db == ":memory:":
+            raise ConfigError("fleet.lease_db must be a file path — the "
+                              "lease table is shared across processes")
+        if self.max_leases < 1:
+            raise ConfigError("fleet.max_leases must be >= 1")
+        if self.backlog < self.max_leases:
+            raise ConfigError("fleet.backlog must be >= fleet.max_leases "
+                              "(a pull may never overshoot the bound)")
+        if self.max_attempts < 1:
+            raise ConfigError("fleet.max_attempts must be >= 1")
+        if self.busy_timeout_ms < 0:
+            raise ConfigError("fleet.busy_timeout_ms must be >= 0")
+
+
+@dataclass(frozen=True)
 class IpfsConfig:
     """Pinning strategy selection (reference `types.ts:3-54` ipfs section):
     local = the node's own ContentStore + gateway (needs store_dir);
@@ -171,6 +228,9 @@ class IpfsConfig:
 @dataclass(frozen=True)
 class MiningConfig:
     db_path: str = ":memory:"
+    # sqlite busy_timeout for the node db (milliseconds): ControlRPC
+    # request threads and the tick thread contend on one file
+    db_busy_timeout_ms: int = 5000
     log_path: str | None = None
     evilmode: bool = False        # fault injection: commit wrong CIDs
     models: tuple[ModelConfig, ...] = ()
@@ -218,6 +278,9 @@ class MiningConfig:
     # profit-aware continuous batching (docs/scheduler.md); default OFF
     # = FIFO arrival-order bucket packing, static-cost gate only
     sched: SchedConfig = SchedConfig()
+    # multi-process fleet mining (docs/fleet.md); default OFF = this
+    # process is a bare single-node miner
+    fleet: FleetConfig = FleetConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -250,6 +313,8 @@ class MiningConfig:
                 "a 0x address")
         if self.obs_journal_capacity < 1:
             raise ConfigError("obs_journal_capacity must be >= 1")
+        if self.db_busy_timeout_ms < 0:
+            raise ConfigError("db_busy_timeout_ms must be >= 0")
         if self.retry_max_delay is not None and self.retry_max_delay <= 0:
             raise ConfigError("retry_max_delay must be positive (or null "
                               "for the uncapped reference curve)")
@@ -308,7 +373,9 @@ def load_config(raw: str | dict) -> MiningConfig:
     ipfs = build(IpfsConfig, obj.pop("ipfs", {}), "ipfs")
     pipeline = build(PipelineConfig, obj.pop("pipeline", {}), "pipeline")
     sched = build(SchedConfig, obj.pop("sched", {}), "sched")
+    fleet = build(FleetConfig, obj.pop("fleet", {}), "fleet")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
-                      ipfs=ipfs, pipeline=pipeline, sched=sched, **obj),
+                      ipfs=ipfs, pipeline=pipeline, sched=sched,
+                      fleet=fleet, **obj),
                  "config")

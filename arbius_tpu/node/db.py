@@ -78,7 +78,8 @@ class Job:
 
 
 class NodeDB:
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:",
+                 busy_timeout_ms: int = 5000):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.row_factory = sqlite3.Row
         self._lock = threading.Lock()
@@ -89,6 +90,13 @@ class NodeDB:
         # durability, exactly what each op did before batching existed)
         self._batch = threading.local()
         with self._lock:
+            # WAL + busy_timeout (conclint CONC406, docs/concurrency.md):
+            # a reader proceeds under a writer mid-commit (ControlRPC
+            # views vs the tick's batch window) and contention becomes a
+            # bounded wait instead of an instant "database is locked".
+            # On :memory: the WAL pragma is a no-op — harmless.
+            self._conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_ms)}")
+            self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.executescript(_SCHEMA)
 
     def _batch_depth(self) -> int:
@@ -221,6 +229,16 @@ class NodeDB:
         with self._lock:
             return self._conn.execute("SELECT COUNT(*) c FROM jobs"
                                       ).fetchone()["c"]
+
+    def count_jobs(self, methods: tuple[str, ...]) -> int:
+        """Jobs (due or waiting) whose method is in `methods` — the
+        fleet worker's backlog gate (docs/fleet.md): lease pulls stop
+        while this many task/solve jobs are already in flight."""
+        marks = ",".join("?" * len(methods))
+        with self._lock:
+            return self._conn.execute(
+                f"SELECT COUNT(*) c FROM jobs WHERE method IN ({marks})",
+                tuple(methods)).fetchone()["c"]
 
     # -- task cache ------------------------------------------------------
     def store_task(self, taskid: str, modelid: str, fee: int, address: str,

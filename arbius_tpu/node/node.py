@@ -155,6 +155,15 @@ class MinerNode:
             "device (the host+network tail the pipeline exists to hide)")
         self.metrics = NodeMetrics(self.obs)
         self._retry_sleep = lambda s: None  # injectable; chain time is fake
+        # fleet worker mode (docs/fleet.md), wired by LeaseFeed.attach:
+        # `task_feed` replaces the TaskSubmitted subscription as the
+        # task source (its pump() runs at the top of every tick — the
+        # lease heartbeat woven into the tick), and `commit_guard` is
+        # consulted before every signalCommitment so two fleet workers
+        # never double-commit one (validator, taskid). Both None = the
+        # bare single-node miner, bit-for-bit.
+        self.task_feed = None
+        self.commit_guard = None
         self.mesh = None          # built + validated at boot (cfg.mesh)
         # mesh-layout tag of the solve programs (part of every cost-model
         # key: a tp2 bucket and a single-device bucket are different
@@ -336,6 +345,11 @@ class MinerNode:
                           ev.args["version"], MINER_VERSION)
 
     def _on_task_submitted(self, args: dict) -> None:
+        if self.task_feed is not None:
+            # fleet worker mode: the coordinator owns the task stream —
+            # work arrives only as leases (docs/fleet.md); the node
+            # stays subscribed for solution/contestation vigilance
+            return
         taskid = "0x" + args["id"].hex()
         model = "0x" + args["model"].hex()
         self._inc("tasks_seen")
@@ -393,6 +407,19 @@ class MinerNode:
             return self._tick()
 
     def _tick(self) -> int:
+        # one tick = one sqlite commit (docs/pipeline.md, db.batch()):
+        # the window covers the event poll and the fleet lease pump
+        # too, not just the job cycle — a poll delivering a burst of
+        # events used to fsync per event-handler write (the 10k fleet
+        # flood surfaced it). Losing the window to a crash is safe on
+        # every path it now covers: a re-poll replays the event range
+        # (RpcChain's cursor is in-memory; handlers dedupe via INSERT
+        # OR IGNORE) and an expired lease whose local jobs vanished is
+        # simply re-dealt (the lease table is the durable record).
+        with self.db.batch():
+            return self._tick_inner()
+
+    def _tick_inner(self) -> int:
         # pull-based backends (RpcChain) deliver events here; the local
         # engine pushes synchronously and has no poll_events. A transport
         # blip must not kill the run() loop — the next tick re-polls the
@@ -403,24 +430,31 @@ class MinerNode:
                 poll()
             except Exception as e:  # noqa: BLE001 — endpoint flake
                 log.warning("event poll failed (will retry): %r", e)
+        if self.task_feed is not None:
+            # fleet worker mode: settle/heartbeat/pull leases before the
+            # queue drains, so freshly leased tasks run this very tick —
+            # the same tick alignment the event path gives a bare node
+            # (docs/fleet.md determinism argument). A lease-db hiccup
+            # must not kill the run loop; the next tick re-pumps.
+            try:
+                self.task_feed.pump(self)
+            except Exception as e:  # noqa: BLE001 — lease-db flake
+                log.warning("lease pump failed (will retry): %r", e)
         jobs = self.db.get_jobs(self.chain.now)
         if not jobs:
             return 0
         done = 0
         concurrent = [j for j in jobs if j.concurrent]
         serial = [j for j in jobs if not j.concurrent]
-        # one tick = one sqlite commit: the claim/delete cycle below
-        # used to fsync per job (docs/pipeline.md, db.batch())
-        with self.db.batch():
-            for job in concurrent:
-                done += self._run_job(job)
-            # dp batching: group due solve jobs into one XLA dispatch
-            solves = [j for j in serial if j.method == "solve"]
-            others = [j for j in serial if j.method != "solve"]
-            if solves:
-                done += self._process_solve_batch(solves)
-            for job in others:
-                done += self._run_job(job)
+        for job in concurrent:
+            done += self._run_job(job)
+        # dp batching: group due solve jobs into one XLA dispatch
+        solves = [j for j in serial if j.method == "solve"]
+        others = [j for j in serial if j.method != "solve"]
+        if solves:
+            done += self._process_solve_batch(solves)
+        for job in others:
+            done += self._run_job(job)
         return done
 
     def _run_job(self, job: Job) -> int:
@@ -872,6 +906,15 @@ class MinerNode:
         if skip_commit:
             progress("commit", resumed=True)
         else:
+            if self.commit_guard is not None and \
+                    not self.commit_guard(taskid, cid):
+                # another fleet worker holds this task's commit rights
+                # and its lease is live (docs/fleet.md cross-process
+                # dedupe): signalling here would double-commit the
+                # fleet's work — skip; the lease pump settles the lease
+                # when their reveal lands
+                self.obs.event("commit_deduped", taskid=taskid, cid=cid)
+                return
             with span("solve.commit", taskid=taskid):
                 commitment = self.chain.generate_commitment(taskid, cid)
                 try:

@@ -47,14 +47,61 @@ class ChainRpcError(RuntimeError):
     """Transport-level failure (endpoint down, timeout) — retryable."""
 
 
+# the devnet's exact rejection shape (chain/devnet.py raises
+# `nonce {got} != expected {want}`) — the structured two-number parse
+_NONCE_CONFLICT_RE = _re.compile(r"\bnonce (\d+) != expected (\d+)\b")
+# geth-family nonce rejections ('nonce too low: next nonce 3, tx nonce
+# 5', 'nonce too high', 'replacement transaction underpriced',
+# 'already known') carry no uniform number pair — recognized as
+# conflicts by their fixed phrases, still MESSAGE-field-only
+_NONCE_PHRASES = ("nonce too low", "nonce too high",
+                  "replacement transaction underpriced",
+                  "already known")
+
+
+def _error_message(e: BaseException) -> str:
+    """The endpoint's error MESSAGE field when one exists (empty string
+    included — an empty message must NOT fall back to the stringified
+    payload, whose `data` field can echo calldata), else str(e)."""
+    msg = getattr(e, "message", None)
+    return str(e) if msg is None else msg
+
+
+def nonce_conflict(e: BaseException) -> tuple[int, int] | None:
+    """Structured nonce-conflict parse: (got, expected) when the error's
+    MESSAGE field carries the devnet `nonce N != expected M` shape,
+    else None. Only the message object is inspected — never the
+    stringified payload: a submitTask input that merely contains the
+    word "nonce" must not be classified as a tx race. Geth-family
+    conflicts without the number pair classify via `is_nonce_error`."""
+    m = _NONCE_CONFLICT_RE.search(_error_message(e))
+    if m is None:
+        return None
+    return int(m.group(1)), int(m.group(2))
+
+
+def is_nonce_error(e: BaseException) -> bool:
+    """True for any recognized nonce-conflict message shape: the
+    devnet's structured pair or a geth-family phrase."""
+    if nonce_conflict(e) is not None:
+        return True
+    msg = _error_message(e)
+    return any(p in msg for p in _NONCE_PHRASES)
+
+
 def _engine_error(e: RpcError):
-    """Map a revert to the facade's EngineError; re-raise transport faults."""
+    """Map a revert to the facade's EngineError; re-raise transport
+    faults. Nonce conflicts (another tx from this wallet landed first —
+    the fleet shared-wallet race, docs/fleet.md) classify as
+    EngineError too: the state-dependent retry logic re-reads chain
+    state exactly as it does for a revert, instead of blind-retrying a
+    tx whose nonce can never land."""
     from arbius_tpu.chain import EngineError
 
-    msg = str(e)
-    if "revert" in msg or "nonce" in msg:
+    msg = _error_message(e)
+    if "revert" in msg or is_nonce_error(e):
         return EngineError(msg)
-    return ChainRpcError(msg)
+    return ChainRpcError(str(e))
 
 
 class RpcChain:

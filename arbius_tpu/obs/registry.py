@@ -124,15 +124,17 @@ class Counter(_Metric):
 
 
 class Gauge(_Metric):
-    """Settable gauge; `fn` (unlabeled only) is read at collect time —
-    the queue-depth pattern, where the source of truth is elsewhere."""
+    """Settable gauge; `fn` is read at collect time — the queue-depth
+    pattern, where the source of truth is elsewhere. A LABELED callback
+    gauge's `fn` returns a mapping of label value (or label-value
+    tuple, for multi-label gauges) to number — the fleet lease-state
+    pattern, where one scrape of the source yields every series
+    (docs/fleet.md, docs/observability.md)."""
 
     kind = "gauge"
 
     def __init__(self, name: str, help: str, labelnames: tuple = (),
                  fn=None):
-        if fn is not None and labelnames:
-            raise ValueError(f"{name}: callback gauges cannot be labeled")
         super().__init__(name, help, labelnames)
         self.fn = fn
 
@@ -160,15 +162,49 @@ class Gauge(_Metric):
             # whole /metrics scrape
             return float("nan")
 
+    def _fn_items(self) -> list[tuple[tuple, float]] | None:
+        """Labeled-callback collect: normalize the mapping's keys to
+        label-value tuples, sorted for stable exposition. None marks a
+        DEAD source (fn raised) — distinct from an empty mapping, which
+        is a legitimately empty series set."""
+        try:
+            raw = self.fn()
+            out = []
+            for key, v in raw.items():
+                if not isinstance(key, tuple):
+                    key = (key,)
+                out.append((tuple(str(k) for k in key), float(v)))
+            return sorted(out)
+        except Exception:  # noqa: BLE001 — same dead-source contract
+            return None
+
     def value(self, **labels) -> float:
         if self.fn is not None:
-            return self._call_fn()
+            if not self.labelnames:
+                return self._call_fn()
+            key = self._key(labels)
+            items = self._fn_items()
+            if items is None:
+                return float("nan")
+            for k, v in items:
+                if k == key:
+                    return v
+            return 0.0
         c = self._peek(labels)
         return c[0] if c is not None else 0.0
 
     def render(self) -> list[str]:
         if self.fn is not None:
-            return [f"{self.name} {_fmt_value(self._call_fn())}"]
+            if not self.labelnames:
+                return [f"{self.name} {_fmt_value(self._call_fn())}"]
+            items = self._fn_items()
+            if items is None:
+                # a scrape must see that the source died, not an empty
+                # (= "all drained") series set — mirror the unlabeled
+                # dead-source NaN on the bare name
+                return [f"{self.name} NaN"]
+            return [f"{self.name}{_label_str(self.labelnames, key)} "
+                    f"{_fmt_value(v)}" for key, v in items]
         lines = [f"{self.name}{_label_str(self.labelnames, key)} "
                  f"{_fmt_value(c[0])}" for key, c in self._items()]
         if not lines and not self.labelnames:
@@ -176,6 +212,13 @@ class Gauge(_Metric):
         return lines
 
     def summary(self):
+        if self.fn is not None and self.labelnames:
+            items = self._fn_items()
+            if items is None:
+                return float("nan")
+            return {",".join(f"{n}={v}" for n, v
+                             in zip(self.labelnames, key)): v
+                    for key, v in items}
         if self.fn is not None or not self.labelnames:
             return self.value()
         return {",".join(f"{n}={v}" for n, v in zip(self.labelnames, key)):

@@ -79,7 +79,42 @@ class RacyCounterMinerNode(MinerNode):
         super().close()
 
 
+class DoubleLeaseWorkerNode(MinerNode):
+    """A fleet worker that violates the lease plane's exclusivity: each
+    tick it scans the shared commit-rights table and signals its OWN
+    commitment for every task another worker already committed — acting
+    as if it held the lease itself (the double-lease a broken lease
+    claim would produce). The chain accepts the commitments (different
+    validators hash differently), so only SIM111's cross-worker dedupe
+    audit can see the violation; it must fail closed. Only meaningful
+    under a fleet scenario (the CLI forces one)."""
+
+    def tick(self) -> int:
+        feed = getattr(self, "task_feed", None)
+        if feed is not None:
+            seen = getattr(self, "_double_leased", None)
+            if seen is None:
+                seen = self._double_leased = set()
+            for row in feed.leases.commit_rows():
+                tid = row["taskid"]
+                if row["worker"] == feed.worker_id or tid in seen:
+                    continue
+                seen.add(tid)
+                try:
+                    second = self.chain.generate_commitment(
+                        tid, row["cid"])
+                    self.chain.signal_commitment(second)
+                except (EngineError, DevnetError):  # pragma: no cover
+                    pass
+        return super().tick()
+
+
 INJECTABLE_BUGS = {
     "double-commit": DoubleCommitMinerNode,
     "racy-counter": RacyCounterMinerNode,
+    "double-lease": DoubleLeaseWorkerNode,
 }
+
+# bugs that only make sense inside a fleet (the CLI swaps the scenario
+# to a fleet one when needed)
+FLEET_BUGS = ("double-lease",)

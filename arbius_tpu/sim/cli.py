@@ -8,6 +8,8 @@ once): exit 0 = every scenario run passed every invariant checker,
     python -m arbius_tpu.sim                         # clean, seed 0
     python -m arbius_tpu.sim --scenario rpc-flap --seed 7
     python -m arbius_tpu.sim --scenario all --seeds 3 --json
+    python -m arbius_tpu.sim --scenario fleet-race   # 2-miner fleet
+    python -m arbius_tpu.sim --flood 10000           # 10k fleet soak
     python -m arbius_tpu.sim --list                  # scenario catalog
     python -m arbius_tpu.sim --inject-bug double-commit   # must exit 1
 """
@@ -44,7 +46,16 @@ def build_arg_parser(p: argparse.ArgumentParser | None = None
                    help="list the scenario catalog and exit")
     p.add_argument("--inject-bug", default=None,
                    help="run with a deliberately broken node (checker "
-                        "regression); known: double-commit, racy-counter")
+                        "regression); known: double-commit, "
+                        "racy-counter, double-lease")
+    p.add_argument("--flood", type=int, default=None, metavar="N",
+                   help="fleet flood soak (docs/fleet.md): push N task "
+                        "lifecycles through a fleet over the in-process "
+                        "engine and audit bounded worker backlogs, "
+                        "lease settlement, and commit dedupe "
+                        "(e.g. --flood 10000)")
+    p.add_argument("--workers", type=int, default=4,
+                   help="fleet size for --flood (default: 4)")
     p.add_argument("--witness", action="store_true",
                    help="instrument the node with the conclint runtime "
                         "witness (docs/concurrency.md): SIM110 audits "
@@ -63,12 +74,17 @@ def build_arg_parser(p: argparse.ArgumentParser | None = None
 
 
 def _resolve_scenarios(name: str):
-    from arbius_tpu.sim.scenario import SCENARIOS, TIER1_MATRIX, get_scenario
+    from arbius_tpu.sim.scenario import (
+        FLEET_TIER1,
+        SCENARIOS,
+        TIER1_MATRIX,
+        get_scenario,
+    )
 
     if name == "all":
         return [SCENARIOS[k] for k in sorted(SCENARIOS)]
     if name == "tier1":
-        return [SCENARIOS[k] for k in TIER1_MATRIX]
+        return [SCENARIOS[k] for k in TIER1_MATRIX + FLEET_TIER1]
     return [get_scenario(name)]
 
 
@@ -87,6 +103,7 @@ def collect(ns: argparse.Namespace):
     from arbius_tpu.sim.scenario import SCENARIOS
 
     ns._runs = []
+    ns._flood = None
     if ns.list:
         for name in sorted(SCENARIOS):
             s = SCENARIOS[name]
@@ -100,6 +117,21 @@ def collect(ns: argparse.Namespace):
                   f"(known: {', '.join(sorted(INJECTABLE_BUGS))})",
                   file=sys.stderr)
             return EXIT_USAGE, []
+    if ns.flood is not None:
+        if ns.flood < 1 or ns.workers < 1:
+            print("simsoak: --flood and --workers must be >= 1",
+                  file=sys.stderr)
+            return EXIT_USAGE, []
+        from arbius_tpu.sim.fleet import FleetFloodHarness, flood_findings
+
+        with tempfile.TemporaryDirectory(prefix="simflood-") as tmp:
+            harness = FleetFloodHarness(ns.flood, ns.workers,
+                                        ns.workdir or tmp, seed=ns.seed)
+            try:
+                ns._flood = harness.run()
+            finally:
+                harness.close()
+        return None, flood_findings(ns._flood)
     try:
         scenarios = _resolve_scenarios(ns.scenario)
     except KeyError as e:
@@ -108,6 +140,14 @@ def collect(ns: argparse.Namespace):
     if ns.seeds < 1:
         print("simsoak: --seeds must be >= 1", file=sys.stderr)
         return EXIT_USAGE, []
+    from arbius_tpu.sim.bugs import FLEET_BUGS
+
+    if ns.inject_bug in FLEET_BUGS and not any(
+            s.fleet is not None for s in scenarios):
+        # a fleet-only bug demonstrates nothing outside a fleet
+        from arbius_tpu.sim.scenario import get_scenario
+
+        scenarios = [get_scenario("fleet-race")]
 
     findings = []
     # racy-counter exists to be caught by the witness's SIM110 —
@@ -120,10 +160,22 @@ def collect(ns: argparse.Namespace):
         for scenario in scenarios:
             scenario = scenario.with_tasks(ns.tasks)
             for seed in range(ns.seed, ns.seed + ns.seeds):
-                db_path = os.path.join(
-                    workdir, f"{scenario.name}-{seed}.sqlite")
-                result = run_scenario(scenario, seed, db_path=db_path,
-                                      node_cls=node_cls, witness=witness)
+                if scenario.fleet is not None:
+                    from arbius_tpu.sim.fleet import run_fleet_scenario
+
+                    fleet_dir = os.path.join(
+                        workdir, f"{scenario.name}-{seed}")
+                    os.makedirs(fleet_dir, exist_ok=True)
+                    result = run_fleet_scenario(scenario, seed,
+                                                workdir=fleet_dir,
+                                                node_cls=node_cls)
+                else:
+                    db_path = os.path.join(
+                        workdir, f"{scenario.name}-{seed}.sqlite")
+                    result = run_scenario(scenario, seed,
+                                          db_path=db_path,
+                                          node_cls=node_cls,
+                                          witness=witness)
                 if result.witness_report is not None:
                     reports.append(result.witness_report)
                 run_findings = check_all(result)
@@ -150,12 +202,29 @@ def collect(ns: argparse.Namespace):
 
 def render(ns: argparse.Namespace, findings, out) -> None:
     runs = getattr(ns, "_runs", [])
+    flood = getattr(ns, "_flood", None)
     if ns.json:
         doc = {"version": 1,
                "findings": [f.to_json() for f in findings],
                "runs": runs}
+        if flood is not None:
+            doc["flood"] = flood
         out.write(json.dumps(doc, indent=2, sort_keys=True) + "\n")
         return
+    if flood is not None:
+        depths = " ".join(f"{w}={d}" for w, d
+                          in sorted(flood["max_backlog"].items()))
+        out.write(
+            f"flood           tasks={flood['tasks']:<6d} "
+            f"workers={flood['workers']} rounds={flood['rounds']:<5d} "
+            f"claimed={flood['claimed']:<6d} "
+            f"dedup={flood['commit_dedup']}\n"
+            f"  worker backlog bound {flood['backlog_bound']}, "
+            f"max depths [{depths}], peak pending leases "
+            f"{flood['max_pending_leases']}\n"
+            f"  sqlite commits per worker "
+            f"{dict(sorted(flood['db_commits'].items()))} "
+            f"(one fsync per tick, not per job)\n")
     for r in runs:
         terminal = " ".join(f"{k}={v}" for k, v in r["terminal"].items())
         faults = sum(r["faults_injected"].values())

@@ -119,6 +119,17 @@ class SimResult:
     # lock-order graph + watched-attr writes; None when the run was not
     # instrumented — SIM110 audits it only when present
     witness_report: dict | None = None
+    # fleet runs (sim/fleet.py, docs/fleet.md): worker validator
+    # addresses in worker-index order, every worker's NodeDB (task
+    # conservation must see ALL local verdicts), and the lease table's
+    # terminal rows + transition history — SIM111 audits these; empty
+    # on single-node runs
+    fleet_workers: list = field(default_factory=list)
+    worker_dbs: list = field(default_factory=list)
+    lease_rows: list = field(default_factory=list)
+    lease_history: list = field(default_factory=list)
+    lease_counts: dict = field(default_factory=dict)
+    commit_rows: list = field(default_factory=list)
 
     def repro(self) -> str:
         return (f"python -m arbius_tpu.sim --scenario "

@@ -41,6 +41,16 @@ reproduces it byte-identically.
                                concurrently-live thread roots — the
                                injected-race regression in sim/bugs.py
                                must trip exactly this
+  SIM111  fleet discipline     fleet runs only (docs/fleet.md): the
+                               per-validator generalization of
+                               SIM102/103 over every worker, no task
+                               committed by two fleet workers (the
+                               cross-process commit dedupe), every
+                               lease terminal at quiescence, expired
+                               leases stolen/reclaimed within the TTL,
+                               and no reveal without granted commit
+                               rights — sim/bugs.py's double-lease
+                               node must trip exactly this
 
 The checkers are deliberately redundant with the engine's own reverts
 (defense in depth): their job is to catch a *node* that violates the
@@ -78,12 +88,21 @@ class SimFinding:
                 "seed": self.seed}
 
 
-def _failed_methods_by_task(db) -> dict[str, list[str]]:
+def _node_dbs(result) -> list:
+    """Every node-local database a verdict can live in: one for a
+    single-node run, one per worker for a fleet run (a task proven
+    invalid or quarantined on worker 2 is accounted, docs/fleet.md)."""
+    dbs = list(getattr(result, "worker_dbs", ()) or ())
+    return dbs if dbs else [result.db]
+
+
+def _failed_methods_by_task(result) -> dict[str, list[str]]:
     out: dict[str, list[str]] = {}
-    for method, data in db.failed_jobs():
-        tid = data.get("taskid")
-        if tid:
-            out.setdefault(tid, []).append(method)
+    for db in _node_dbs(result):
+        for method, data in db.failed_jobs():
+            tid = data.get("taskid")
+            if tid:
+                out.setdefault(tid, []).append(method)
     return out
 
 
@@ -91,7 +110,8 @@ def classify_tasks(result) -> dict[str, str]:
     """One terminal label per submitted task (precedence order: dispute
     outcome > chain solution state > node-local verdicts)."""
     labels: dict[str, str] = {}
-    failed = _failed_methods_by_task(result.db)
+    failed = _failed_methods_by_task(result)
+    dbs = _node_dbs(result)
     for tid in result.tasks:
         tb = bytes.fromhex(tid[2:])
         sol = result.engine.solutions.get(tb)
@@ -113,7 +133,7 @@ def classify_tasks(result) -> dict[str, str]:
                 labels[tid] = "quarantined"
             else:
                 labels[tid] = "unclaimed"
-        elif result.db.is_invalid_task(tid):
+        elif any(db.is_invalid_task(tid) for db in dbs):
             labels[tid] = "invalid"
         elif failed.get(tid):
             labels[tid] = "quarantined"
@@ -156,46 +176,63 @@ def check_task_conservation(result, find) -> None:
                  f"; {detail})")
 
 
-def _miner_writes(result, method: str):
+def _sender_writes(result, method: str, sender: str):
     return [r for r in result.plane.audit
-            if r.ok and r.method == method
-            and r.sender == result.miner_address]
+            if r.ok and r.method == method and r.sender == sender]
 
 
-def check_commit_before_reveal(result, find) -> None:
+def _miner_writes(result, method: str):
+    return _sender_writes(result, method, result.miner_address)
+
+
+def _check_commit_before_reveal_for(result, find, sender: str,
+                                    rule: str = "SIM102") -> None:
     commits = {r.values[0]: r
-               for r in _miner_writes(result, "signalCommitment")}
-    for rev in _miner_writes(result, "submitSolution"):
+               for r in _sender_writes(result, "signalCommitment",
+                                       sender)}
+    for rev in _sender_writes(result, "submitSolution", sender):
         taskid, cid = rev.values
         tid = "0x" + taskid.hex()
-        expected = generate_commitment(result.miner_address, taskid, cid)
+        expected = generate_commitment(sender, taskid, cid)
         commit = commits.get(expected)
         if commit is None:
-            find("SIM102", tid,
+            find(rule, tid,
                  f"solution 0x{cid.hex()} revealed at block {rev.block} "
-                 "with NO matching signalCommitment in the audit trace")
+                 f"by {sender} with NO matching signalCommitment in the "
+                 "audit trace")
         elif commit.block >= rev.block:
-            find("SIM102", tid,
+            find(rule, tid,
                  f"commit landed at block {commit.block} but the reveal "
                  f"landed at block {rev.block} — commit must be strictly "
                  "earlier")
 
 
-def check_no_duplicate_commitment(result, find) -> None:
+def check_commit_before_reveal(result, find) -> None:
+    _check_commit_before_reveal_for(result, find, result.miner_address)
+
+
+def _check_no_duplicate_commitment_for(result, find, sender: str,
+                                       rule: str = "SIM103") -> None:
     landed_blocks = {r.values[0]: r.block
-                     for r in _miner_writes(result, "signalCommitment")}
+                     for r in _sender_writes(result, "signalCommitment",
+                                             sender)}
     per_task: dict[tuple[str, str], dict[str, int]] = {}
-    for chash, (sender, tid, cid) in result.plane.commitments.items():
-        if chash not in landed_blocks or sender != result.miner_address:
+    for chash, (csender, tid, cid) in result.plane.commitments.items():
+        if chash not in landed_blocks or csender != sender:
             continue
-        per_task.setdefault((sender, tid), {})[cid] = landed_blocks[chash]
-    for (sender, tid), cids in per_task.items():
+        per_task.setdefault((csender, tid), {})[cid] = landed_blocks[chash]
+    for (csender, tid), cids in per_task.items():
         if len(cids) > 1:
             listing = ", ".join(f"{cid} @ block {blk}"
                                 for cid, blk in sorted(cids.items()))
-            find("SIM103", tid,
-                 f"validator {sender} signalled {len(cids)} different "
+            find(rule, tid,
+                 f"validator {csender} signalled {len(cids)} different "
                  f"commitments for one task — a double-commit: {listing}")
+
+
+def check_no_duplicate_commitment(result, find) -> None:
+    _check_no_duplicate_commitment_for(result, find,
+                                       result.miner_address)
 
 
 def check_stake_never_negative(result, find) -> None:
@@ -374,6 +411,95 @@ def check_witness(result, find) -> None:
                  "CONC401 race is live at runtime, not just static")
 
 
+def check_fleet(result, find) -> None:
+    """SIM111 (fleet runs only, docs/fleet.md): the per-validator
+    generalization of the single-node invariants plus the lease-plane
+    contract.
+
+      (a) SIM102/SIM103 per worker: every fleet validator's reveals
+          have a strictly-earlier matching commit, and no validator
+          double-commits one task;
+      (b) cross-process commit dedupe: no task is committed by two
+          DIFFERENT fleet workers — the wasted-work race the lease
+          table's claim_commit exists to prevent (the shipped
+          scenarios never cross a reclaim-after-commit boundary, so
+          one committer per task is exact there; sim/bugs.py's
+          double-lease node must trip this);
+      (c) every lease terminal after drain (a pending/leased row at
+          quiescence is a lost or stuck task);
+      (d) expired leases reclaimed/stolen within the TTL: the steal/
+          reclaim lag recorded in the lease history never exceeds
+          max(lease_ttl, 2 × tick_seconds) — a dead worker's tasks
+          become someone else's work, promptly;
+      (e) commit-rights rows match what actually landed on chain: the
+          registered CID of each fleet reveal equals the rights-holder
+          row's CID (the dedupe table cannot drift from the chain)."""
+    workers = getattr(result, "fleet_workers", ())
+    if not workers:
+        return
+    for addr in workers:
+        _check_commit_before_reveal_for(result, find, addr,
+                                        rule="SIM111")
+        _check_no_duplicate_commitment_for(result, find, addr,
+                                           rule="SIM111")
+    committers: dict[str, set] = {}
+    for addr in workers:
+        for r in _sender_writes(result, "signalCommitment", addr):
+            reg = result.plane.commitments.get(r.values[0])
+            if reg is not None:
+                committers.setdefault(reg[1], set()).add(addr)
+    for tid, who in sorted(committers.items()):
+        if len(who) > 1:
+            find("SIM111", tid,
+                 f"{len(who)} fleet workers {sorted(who)} each "
+                 "signalled a commitment for one task — the "
+                 "cross-process commit dedupe failed (double-lease)")
+    for row in getattr(result, "lease_rows", ()):
+        if row["state"] not in ("done", "invalid", "failed"):
+            find("SIM111", row["taskid"],
+                 f"lease stuck non-terminal after drain: state "
+                 f"{row['state']!r} held by {row['worker']!r} "
+                 f"(attempts {row['attempts']}, steals {row['steals']})")
+    spec = result.scenario.fleet
+    if spec is not None:
+        lag_bound = max(spec.lease_ttl, 2 * result.scenario.tick_seconds)
+        for op, tid, worker, now, extra in getattr(
+                result, "lease_history", ()):
+            if op in ("steal", "reclaim") and \
+                    extra.get("lag", 0) > lag_bound:
+                find("SIM111", tid,
+                     f"expired lease lingered {extra['lag']}s past its "
+                     f"heartbeat before {op} (bound {lag_bound}s) — "
+                     "reclaim is not keeping up with the TTL")
+    worker_of_addr = {addr: f"worker-{i}"
+                      for i, addr in enumerate(workers)}
+    claims = {}
+    for op, tid, worker, now, extra in getattr(result,
+                                               "lease_history", ()):
+        if op == "commit_claim":
+            claims.setdefault(tid, []).append(worker)
+    rights = {row["taskid"]: row
+              for row in getattr(result, "commit_rows", ())}
+    for addr in workers:
+        for r in _sender_writes(result, "submitSolution", addr):
+            tid = "0x" + r.values[0].hex()
+            cid = "0x" + r.values[1].hex()
+            holders = claims.get(tid, [])
+            if holders and worker_of_addr[addr] not in holders:
+                find("SIM111", tid,
+                     f"{worker_of_addr[addr]} ({addr}) revealed a "
+                     "solution without ever being granted the task's "
+                     f"commit rights (granted to {sorted(set(holders))})"
+                     " — the commit guard was bypassed")
+            row = rights.get(tid)
+            if row is not None and row["cid"] != cid:
+                find("SIM111", tid,
+                     f"commit-rights table records CID {row['cid']} "
+                     f"(holder {row['worker']}) but {addr} revealed "
+                     f"{cid} on chain — the dedupe table drifted from "
+                     "the chain")
+
+
 CHECKERS = (
     check_task_conservation,
     check_commit_before_reveal,
@@ -385,6 +511,7 @@ CHECKERS = (
     check_liveness,
     check_stage_order,
     check_witness,
+    check_fleet,
 )
 
 
@@ -406,7 +533,7 @@ def summarize(result) -> dict:
     terminal: dict[str, int] = {}
     for label in labels.values():
         terminal[label] = terminal.get(label, 0) + 1
-    return {
+    doc = {
         "scenario": result.scenario.name,
         "seed": result.seed,
         "tasks": len(result.tasks),
@@ -422,3 +549,23 @@ def summarize(result) -> dict:
         - result.engine.start_block_time,
         "quiescent": result.quiescent,
     }
+    if getattr(result, "fleet_workers", ()):
+        # fleet runs only — single-node summaries stay byte-identical
+        # to their pre-fleet shape (test-pinned)
+        per_worker: dict[str, int] = {}
+        for s in result.engine.solutions.values():
+            if s.validator in result.fleet_workers:
+                per_worker[s.validator] = per_worker.get(
+                    s.validator, 0) + 1
+        doc["fleet"] = {
+            "workers": len(result.fleet_workers),
+            "per_worker_solutions": dict(sorted(per_worker.items())),
+            "lease_counts": dict(sorted(result.lease_counts.items())),
+            "steals": sum(1 for h in result.lease_history
+                          if h[0] == "steal"),
+            "reclaims": sum(1 for h in result.lease_history
+                            if h[0] == "reclaim"),
+            "commit_dedups": sum(1 for h in result.lease_history
+                                 if h[0] == "commit_dedup"),
+        }
+    return doc

@@ -46,6 +46,31 @@ class FaultSpec:
 
 
 @dataclass(frozen=True)
+class FleetSpec:
+    """Fleet topology + failure schedule for multi-node scenarios
+    (docs/fleet.md): N workers race one coordinator-owned event stream
+    through the shared lease table; pause windows model partitions
+    (the member can reach neither the chain nor the lease db for those
+    rounds), and the coordinator crash-restart proves lease recovery."""
+
+    workers: int = 2
+    lease_ttl: int = 30            # chain-seconds before a lease is stealable
+    wallet_mode: str = "per-worker"
+    max_leases: int = 2            # pulls per worker per tick
+    backlog: int = 4               # worker task/solve backlog bound
+    max_attempts: int = 4          # lease deliveries before failed
+    # (worker_index, from_round, to_round): that worker skips its ticks
+    # in [from, to) — its leases expire and MUST be stolen
+    pause_worker: tuple = ()
+    # (from_round, to_round): the coordinator skips its ticks — intake
+    # stalls but leased work keeps mining
+    pause_coordinator: tuple = ()
+    # round at which the coordinator is killed and rebuilt from the
+    # on-disk lease table + a from-genesis event re-poll
+    crash_coordinator_round: int | None = None
+
+
+@dataclass(frozen=True)
 class Scenario:
     name: str
     description: str = ""
@@ -59,6 +84,7 @@ class Scenario:
     burst: int = 1                 # tasks submitted per round (flood > 1)
     families: int = 1              # registered model families to mix
     sched: bool = False            # costsched packer on (docs/scheduler.md)
+    fleet: FleetSpec | None = None  # multi-node fleet run (docs/fleet.md)
     faults: FaultSpec = field(default_factory=FaultSpec)
 
     def to_json(self) -> dict:
@@ -116,6 +142,33 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
         tasks=16, burst=4, families=2, sched=True, strict=True,
         faults=FaultSpec(latency_max=3, runner_slow_seconds=2)),
     Scenario(
+        name="fleet-race",
+        description="two miners race one coordinator-owned event "
+                    "stream through the shared lease table (bursts of "
+                    "4, so both actually pull work): every task "
+                    "claimed exactly once fleet-wide, no cross-worker "
+                    "double-commit (SIM111)",
+        tasks=8, burst=4, strict=True, fleet=FleetSpec(workers=2)),
+    Scenario(
+        name="fleet-partition",
+        description="worker 1 AND the coordinator partitioned mid-run: "
+                    "worker 1's leases expire and worker 0 steals them "
+                    "directly (no coordinator sweep available), task "
+                    "intake stalls and then catches up — no task lost "
+                    "either way",
+        tasks=12, burst=4, strict=True,
+        fleet=FleetSpec(workers=2, lease_ttl=20,
+                        pause_worker=(1, 3, 9),
+                        pause_coordinator=(4, 10))),
+    Scenario(
+        name="fleet-coord-crash",
+        description="the coordinator is killed mid-run and rebuilt "
+                    "from the on-disk lease table + a from-genesis "
+                    "event re-poll: every in-flight lease recovered, "
+                    "every task still claimed",
+        tasks=8, burst=3, strict=True,
+        fleet=FleetSpec(workers=2, crash_coordinator_round=4)),
+    Scenario(
         name="chaos",
         description="everything at once, at moderated rates — the soak "
                     "mix for tools/simsoak.py",
@@ -135,6 +188,11 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
 # ordering bug, so it gates tier-1 too
 TIER1_MATRIX = ("clean", "rpc-flap", "pin-fail", "reorg",
                 "crash-restart", "contested", "chaos")
+
+# the fleet half of the matrix (docs/fleet.md): multi-node scenarios
+# driven by sim/fleet.py's harness and audited by SIM111 on top of the
+# applicable SIM1xx set; `--scenario tier1` runs both halves
+FLEET_TIER1 = ("fleet-race", "fleet-partition", "fleet-coord-crash")
 
 
 def get_scenario(name: str) -> Scenario:

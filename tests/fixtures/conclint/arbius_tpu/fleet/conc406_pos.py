@@ -1,0 +1,14 @@
+"""CONC406 positives: fleet-path sqlite handles missing the
+cross-process discipline — no busy_timeout at all, and a WAL-less
+handle on the shared database."""
+import sqlite3
+
+
+def open_naked(path):
+    return sqlite3.connect(path)           # CONC406: no busy_timeout
+
+
+def open_half(path):
+    conn = sqlite3.connect(path)           # CONC406: timeout but no WAL
+    conn.execute("PRAGMA busy_timeout=5000")
+    return conn

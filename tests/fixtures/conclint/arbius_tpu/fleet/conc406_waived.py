@@ -1,0 +1,15 @@
+"""CONC406 waived twin: the full discipline, plus a reasoned waiver."""
+import sqlite3
+
+
+def open_disciplined(path, busy_timeout_ms=5000):
+    conn = sqlite3.connect(path)           # clean: both pragmas below
+    conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_ms)}")
+    conn.execute("PRAGMA journal_mode=WAL")
+    return conn
+
+
+def open_scratch(path):
+    # detlint: allow[CONC406] throwaway single-process scratch db for a
+    # dump tool — nothing else ever opens this file
+    return sqlite3.connect(path)

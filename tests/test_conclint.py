@@ -630,3 +630,35 @@ def test_module_entrypoint_runs_clean_on_tree():
          "--baseline", str(REPO / "conclint-baseline.json")],
         capture_output=True, text=True, env=env, cwd=str(REPO))
     assert out.returncode == 0, out.stdout + out.stderr
+
+
+# -- CONC406: cross-process sqlite discipline (docs/fleet.md) ---------------
+
+def test_conc406_fixture_pair_fires_and_waives():
+    """Path-scoped like CONC302: the fixture tree mirrors
+    arbius_tpu/fleet/ so the rule sees a shared-db path."""
+    findings, _, _ = analyze_conc_tree(
+        [str(FIXDIR / "arbius_tpu")], root=str(FIXDIR))
+    assert rules_of(findings) == ["CONC406", "CONC406"]
+    assert all(f.path.endswith("conc406_pos.py") for f in findings)
+    assert "busy_timeout" in findings[0].message
+    assert "journal_mode=WAL" in findings[1].message
+
+
+def test_conc406_out_of_scope_paths_are_ignored():
+    src = "import sqlite3\n\ndef f(p):\n    return sqlite3.connect(p)\n"
+    findings, _ = analyze_conc_sources({"tools/dumper.py": src})
+    assert "CONC406" not in rules_of(findings)
+    findings, _ = analyze_conc_sources(
+        {"arbius_tpu/node/somedb.py": src})
+    assert rules_of(findings) == ["CONC406"]
+    # node-scoped handles need busy_timeout but NOT WAL (single file,
+    # single process — only the fleet db is shared)
+    ok = ("import sqlite3\n\ndef f(p):\n"
+          "    c = sqlite3.connect(p)\n"
+          "    c.execute('PRAGMA busy_timeout=5000')\n"
+          "    return c\n")
+    findings, _ = analyze_conc_sources({"arbius_tpu/node/somedb.py": ok})
+    assert "CONC406" not in rules_of(findings)
+    findings, _ = analyze_conc_sources({"arbius_tpu/fleet/somedb.py": ok})
+    assert rules_of(findings) == ["CONC406"]

@@ -1,0 +1,535 @@
+"""Fleet unit suite: lease table semantics, coordinator intake, the
+worker-mode seams, commit dedupe, wallet guard, config validation, and
+the satellites that rode the fleet PR (NodeDB WAL/busy_timeout,
+structured nonce-conflict classification, labeled callback gauges).
+
+The end-to-end fleet scenarios (SIM111, partitions, coordinator crash,
+the 10k flood) live in tests/test_sim.py with the rest of the simnet
+matrix; this file covers the pieces in isolation.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from arbius_tpu.fleet import (
+    FleetCoordinator,
+    LeaseFeed,
+    LeaseTable,
+    connect_fleet_db,
+    make_worker_id,
+)
+from arbius_tpu.node.config import ConfigError, FleetConfig, load_config
+
+
+@pytest.fixture
+def table(tmp_path):
+    t = LeaseTable(str(tmp_path / "leases.sqlite"))
+    yield t
+    t.close()
+
+
+# -- lease table -----------------------------------------------------------
+
+def test_add_acquire_in_insertion_order(table):
+    for i in range(5):
+        table.add_task(f"0x{i:02d}", "0xm", fee=i, blocktime=100 + i,
+                       now=100 + i)
+    grants = table.acquire("worker-0", now=110, ttl=30, limit=3)
+    assert [g.taskid for g in grants] == ["0x00", "0x01", "0x02"]
+    assert all(not g.stolen and g.attempts == 1 for g in grants)
+    # the rest stays pending; re-acquire skips what is already leased
+    more = table.acquire("worker-1", now=110, ttl=30, limit=10)
+    assert [g.taskid for g in more] == ["0x03", "0x04"]
+    assert table.counts() == {"leased": 5}
+
+
+def test_add_task_is_replay_idempotent(table):
+    assert table.add_task("0xaa", "0xm", 1, 100, 100)
+    assert not table.add_task("0xaa", "0xm", 1, 100, 101)
+    assert table.counts() == {"pending": 1}
+
+
+def test_expired_lease_is_stolen_with_lag_recorded(table):
+    table.add_task("0xaa", "0xm", 1, 100, 100)
+    table.acquire("worker-0", now=100, ttl=30, limit=1)
+    # not yet expired: nothing to steal
+    assert table.acquire("worker-1", now=120, ttl=30, limit=1) == []
+    grants = table.acquire("worker-1", now=140, ttl=30, limit=1)
+    assert [g.taskid for g in grants] == ["0xaa"]
+    assert grants[0].stolen and grants[0].attempts == 2
+    steal = [h for h in table.history if h[0] == "steal"]
+    assert steal and steal[0][4]["lag"] == 140 - 130
+
+
+def test_heartbeat_keeps_a_lease_unstealable(table):
+    table.add_task("0xaa", "0xm", 1, 100, 100)
+    table.acquire("worker-0", now=100, ttl=30, limit=1)
+    assert table.heartbeat("worker-0", now=125, ttl=30) == 1
+    assert table.acquire("worker-1", now=140, ttl=30, limit=1) == []
+    assert table.held("worker-0") == ["0xaa"]
+
+
+def test_complete_is_holder_agnostic_and_terminal_once(table):
+    table.add_task("0xaa", "0xm", 1, 100, 100)
+    table.acquire("worker-0", now=100, ttl=30, limit=1)
+    # another worker observed the solution on chain — it may settle
+    assert table.complete("0xaa", "worker-1", now=110) == 10.0
+    assert table.complete("0xaa", "worker-1", now=111) is None
+    assert table.counts() == {"done": 1}
+
+
+def test_release_returns_to_pending_then_fails_at_attempt_bound(table):
+    table.add_task("0xaa", "0xm", 1, 100, 100)
+    for attempt in range(1, 3):
+        g = table.acquire(f"worker-{attempt}", now=100 + attempt,
+                          ttl=30, limit=1)
+        assert g[0].attempts == attempt
+        state = table.release("0xaa", f"worker-{attempt}",
+                              now=101 + attempt, max_attempts=2)
+        assert state == ("pending" if attempt < 2 else "failed")
+    assert table.counts() == {"failed": 1}
+
+
+def test_reclaim_sweeps_expired_leases(table):
+    for i in range(2):
+        table.add_task(f"0x{i:02d}", "0xm", 1, 100, 100)
+    table.acquire("worker-0", now=100, ttl=30, limit=2)
+    assert table.reclaim(now=120, max_attempts=4) == []
+    swept = table.reclaim(now=131, max_attempts=4)
+    assert [(t, w) for t, w, _ in swept] == \
+        [("0x00", "worker-0"), ("0x01", "worker-0")]
+    assert swept[0][2] == 1  # lag past expiry
+    assert table.counts() == {"pending": 2}
+
+
+def test_claim_commit_grant_deny_and_takeover(table):
+    table.add_task("0xaa", "0xm", 1, 100, 100)
+    table.acquire("worker-0", now=100, ttl=30, limit=1)
+    assert table.claim_commit("0xaa", "0xv0", "worker-0", "0xcid", 101)
+    # idempotent resume for the holder
+    assert table.claim_commit("0xaa", "0xv0", "worker-0", "0xcid", 102)
+    # denied while the holder's lease is live
+    assert not table.claim_commit("0xaa", "0xv1", "worker-1", "0xcid",
+                                  110)
+    # after the holder's lease expires and is stolen, rights transfer
+    table.acquire("worker-1", now=140, ttl=30, limit=1)
+    assert table.claim_commit("0xaa", "0xv1", "worker-1", "0xcid", 141)
+    rows = table.commit_rows()
+    assert [(r["taskid"], r["worker"]) for r in rows] == \
+        [("0xaa", "worker-1")]
+
+
+def test_two_handles_on_one_file_interoperate(tmp_path):
+    """The cross-process analogue: two LeaseTable objects (separate
+    sqlite connections) on one file see each other's transitions."""
+    path = str(tmp_path / "shared.sqlite")
+    a, b = LeaseTable(path), LeaseTable(path)
+    try:
+        a.add_task("0xaa", "0xm", 1, 100, 100)
+        grants = b.acquire("worker-b", now=100, ttl=30, limit=1)
+        assert [g.taskid for g in grants] == ["0xaa"]
+        assert a.counts() == {"leased": 1}
+        assert a.acquire("worker-a", now=101, ttl=30, limit=1) == []
+    finally:
+        a.close()
+        b.close()
+
+
+def test_connect_fleet_db_sets_the_discipline(tmp_path):
+    conn = connect_fleet_db(str(tmp_path / "x.sqlite"),
+                            busy_timeout_ms=1234)
+    assert conn.execute("PRAGMA busy_timeout").fetchone()[0] == 1234
+    assert conn.execute("PRAGMA journal_mode").fetchone()[0] == "wal"
+    conn.close()
+
+
+def test_wallet_guard_serializes_across_threads(table):
+    """The shared-wallet mutex: a second enter blocks until the first
+    exits (BEGIN IMMEDIATE on the shared file)."""
+    order = []
+    entered = threading.Event()
+    release = threading.Event()
+
+    def first():
+        with table.wallet_guard("0xAB", "worker-0"):
+            order.append("first-in")
+            entered.set()
+            release.wait(timeout=5)
+        order.append("first-out")
+
+    # second guard on its OWN handle (another "process")
+    other = LeaseTable(table._path)
+    t1 = threading.Thread(target=first)
+    t1.start()
+    assert entered.wait(timeout=5)
+
+    def second():
+        with other.wallet_guard("0xAB", "worker-1"):
+            order.append("second-in")
+
+    t2 = threading.Thread(target=second)
+    t2.start()
+    t2.join(timeout=0.3)
+    assert "second-in" not in order  # still blocked behind first
+    release.set()
+    t1.join(timeout=5)
+    t2.join(timeout=5)
+    other.close()
+    assert order == ["first-in", "first-out", "second-in"]
+
+
+def test_tx_guard_reads_nonce_inside_the_guard():
+    """EngineRpcClient.send_to must do nonce-read → sign → send inside
+    the guard window, not sign first."""
+    from contextlib import contextmanager
+
+    from arbius_tpu.chain.rpc_client import EngineRpcClient
+    from arbius_tpu.chain.wallet import Wallet
+
+    events = []
+
+    class Transport:
+        def request(self, method, params):
+            events.append(method)
+            if method == "eth_getTransactionCount":
+                return "0x7"
+            if method == "eth_gasPrice":
+                return "0x10"
+            return "0x" + "00" * 32
+
+    @contextmanager
+    def guard():
+        events.append("guard-enter")
+        yield
+        events.append("guard-exit")
+
+    client = EngineRpcClient(Transport(), "0x" + "11" * 20,
+                             Wallet.from_hex("0x" + "a1" * 32),
+                             chain_id=31337, tx_guard=guard)
+    client.send("signalCommitment", [b"\x00" * 32])
+    assert events[0] == "guard-enter"
+    assert events[-1] == "guard-exit"
+    assert "eth_getTransactionCount" in events[1:-1]
+    assert "eth_sendRawTransaction" in events[1:-1]
+
+
+# -- coordinator + feed ----------------------------------------------------
+
+def _world():
+    from arbius_tpu.chain import Engine
+    from arbius_tpu.chain.fixedpoint import WAD
+    from arbius_tpu.chain.token import TokenLedger
+    from arbius_tpu.node import LocalChain
+
+    tok = TokenLedger()
+    eng = Engine(tok, start_time=100_000)
+    tok.mint(Engine.ADDRESS, 600_000 * WAD)
+    user = "0x" + "b2" * 20
+    miner = "0x" + "a1" * 20
+    for a in (user, miner):
+        tok.mint(a, 10_000 * WAD)
+        tok.approve(a, Engine.ADDRESS, 10**30)
+    tok.transfer(Engine.ADDRESS, "0x" + "99" * 20, 100_000 * WAD)
+    eng.validator_deposit(miner, miner, 400 * WAD)
+    mid = "0x" + eng.register_model(
+        user, user, 0, b'{"meta":{"title":"t"}}').hex()
+    return eng, LocalChain(eng, user), LocalChain(eng, miner), mid
+
+
+def _submit(user_chain, mid, i=0):
+    from arbius_tpu.chain.fixedpoint import WAD
+
+    user_chain.submit_task(
+        0, user_chain.address, mid, 1 * WAD,
+        json.dumps({"prompt": f"t {i}", "negative_prompt": ""},
+                   sort_keys=True).encode())
+
+
+def test_coordinator_leases_only_registered_models(tmp_path):
+    eng, user, miner, mid = _world()
+    table = LeaseTable(str(tmp_path / "l.sqlite"))
+    other = "0x" + eng.register_model(
+        user.address, user.address, 0, b'{"meta":{"title":"o"}}').hex()
+    FleetCoordinator(LocalChainView(eng), table, [mid],
+                     FleetConfig(enabled=True))
+    _submit(user, mid, 0)
+    _submit(user, other, 1)
+    counts = table.counts()
+    assert counts == {"pending": 1}
+    row = table.rows()[0]
+    assert row["model"] == mid and row["state"] == "pending"
+    table.close()
+
+
+class LocalChainView:
+    """Minimal coordinator chain facade over the in-process engine."""
+
+    def __init__(self, engine):
+        from arbius_tpu.node import LocalChain
+
+        self._inner = LocalChain(engine, "0x" + "cc" * 20)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _worker_node(eng, miner, mid, table, tmp_path, index=0,
+                 fleet_cfg=None):
+    import hashlib
+
+    from arbius_tpu.node import (
+        MinerNode,
+        MiningConfig,
+        ModelConfig,
+        ModelRegistry,
+        NodeDB,
+        RegisteredModel,
+    )
+    from arbius_tpu.templates.engine import load_template
+
+    def runner(hydrated, seed):
+        canon = json.dumps(
+            {k: v for k, v in hydrated.items() if k != "seed"},
+            sort_keys=True).encode()
+        return {"out-1.png":
+                hashlib.sha256(canon + seed.to_bytes(8, "big")).digest()}
+
+    registry = ModelRegistry()
+    registry.register(RegisteredModel(
+        id=mid, template=load_template("anythingv3"), runner=runner))
+    cfg = MiningConfig(models=(ModelConfig(id=mid,
+                                           template="anythingv3"),))
+    node = MinerNode(miner, cfg, registry, db=NodeDB(":memory:"),
+                     store=None, pinner=None)
+    fleet_cfg = fleet_cfg or FleetConfig(
+        enabled=True, max_leases=2, backlog=3,
+        lease_db=str(tmp_path / "unused.sqlite"))
+    feed = LeaseFeed(table, make_worker_id(index), fleet_cfg)
+    feed.attach(node)
+    node.boot(skip_self_test=True)
+    return node, feed
+
+
+def test_worker_mode_ignores_task_events_and_pulls_leases(tmp_path):
+    eng, user, miner, mid = _world()
+    table = LeaseTable(str(tmp_path / "l.sqlite"))
+    node, feed = _worker_node(eng, miner, mid, table, tmp_path)
+    coord = FleetCoordinator(LocalChainView(eng), table, [mid],
+                             FleetConfig(enabled=True))
+    for i in range(5):
+        _submit(user, mid, i)
+    # the node saw the TaskSubmitted events but queued NOTHING itself
+    assert not node.db.has_job("task", {"taskid": table.rows()[0]["taskid"]})
+    node.tick()   # pump: pulls min(max_leases=2, backlog=3) = 2
+    assert len(table.held("worker-0")) + \
+        table.counts().get("done", 0) >= 2
+    # backlog gate: with 3 task/solve jobs in flight no further pull
+    depth = node.db.count_jobs(("task", "solve", "pinTaskInput"))
+    assert depth <= 3
+    table.close()
+
+
+def test_fleet_lifecycle_settles_every_lease(tmp_path):
+    eng, user, miner, mid = _world()
+    table = LeaseTable(str(tmp_path / "l.sqlite"))
+    node, feed = _worker_node(eng, miner, mid, table, tmp_path)
+    FleetCoordinator(LocalChainView(eng), table, [mid],
+                     FleetConfig(enabled=True))
+    for i in range(4):
+        _submit(user, mid, i)
+    for _ in range(40):
+        node.tick()
+        counts = table.counts()
+        if counts.get("done", 0) == 4:
+            break
+        jobs = [j for j in node.db.get_jobs(2**60, limit=100)
+                if j.method not in ("automine", "validatorStake")]
+        if jobs and all(j.waituntil > eng.now for j in jobs):
+            eng.advance_time(max(j.waituntil for j in jobs) - eng.now,
+                             blocks=1)
+    assert table.counts() == {"done": 4}
+    assert sum(1 for s in eng.solutions.values() if s.claimed) == 4
+    table.close()
+
+
+def test_commit_guard_skips_second_committer(tmp_path):
+    """Unit version of the cross-process dedupe: rights already granted
+    to a live other worker → the node journals commit_deduped and
+    signals nothing."""
+    eng, user, miner, mid = _world()
+    table = LeaseTable(str(tmp_path / "l.sqlite"))
+    node, feed = _worker_node(eng, miner, mid, table, tmp_path)
+    table.add_task("0x" + "ab" * 32, mid, 1, 100, 100)
+    # worker-9 holds the lease AND the rights, live
+    table.acquire("worker-9", now=eng.now, ttl=10**6, limit=1)
+    assert table.claim_commit("0x" + "ab" * 32, "0xother", "worker-9",
+                              "0xcid", eng.now)
+    before = len(eng.commitments)
+    node._commit_reveal("0x" + "ab" * 32, "0x1220" + "00" * 32, eng.now)
+    assert len(eng.commitments) == before
+    deduped = [e for e in node.obs.journal.events()
+               if e.get("kind") == "commit_deduped"]
+    assert deduped and deduped[0]["taskid"] == "0x" + "ab" * 32
+    assert node.obs.registry.counter(
+        "arbius_fleet_commit_dedup_total").value() == 1
+    table.close()
+
+
+def test_invalid_task_settles_lease_invalid(tmp_path):
+    eng, user, miner, mid = _world()
+    table = LeaseTable(str(tmp_path / "l.sqlite"))
+    node, feed = _worker_node(eng, miner, mid, table, tmp_path)
+    FleetCoordinator(LocalChainView(eng), table, [mid],
+                     FleetConfig(enabled=True))
+    from arbius_tpu.chain.fixedpoint import WAD
+
+    user.submit_task(0, user.address, mid, 1 * WAD, b'{"prompt": broken')
+    node.tick()   # lease + task job (hydration fails -> invalid)
+    node.tick()   # settle pass sees the invalid verdict
+    assert table.counts() == {"invalid": 1}
+    table.close()
+
+
+# -- config ----------------------------------------------------------------
+
+def test_fleet_config_validation():
+    with pytest.raises(ConfigError, match="workers"):
+        FleetConfig(workers=0)
+    with pytest.raises(ConfigError, match="lease_ttl"):
+        FleetConfig(lease_ttl=0)
+    with pytest.raises(ConfigError, match="wallet_mode"):
+        FleetConfig(wallet_mode="communal")
+    with pytest.raises(ConfigError, match="lease_db"):
+        FleetConfig(lease_db=":memory:")
+    with pytest.raises(ConfigError, match="max_leases"):
+        FleetConfig(max_leases=0)
+    with pytest.raises(ConfigError, match="backlog"):
+        FleetConfig(max_leases=4, backlog=2)
+    with pytest.raises(ConfigError, match="max_attempts"):
+        FleetConfig(max_attempts=0)
+    with pytest.raises(ConfigError, match="busy_timeout"):
+        FleetConfig(busy_timeout_ms=-1)
+
+
+def test_fleet_block_loads_from_config_json():
+    cfg = load_config(json.dumps({
+        "fleet": {"enabled": True, "workers": 3, "lease_ttl": 45,
+                  "wallet_mode": "shared"}}))
+    assert cfg.fleet.enabled and cfg.fleet.workers == 3
+    assert cfg.fleet.lease_ttl == 45
+    assert cfg.fleet.wallet_mode == "shared"
+    with pytest.raises(ConfigError, match="fleet"):
+        load_config(json.dumps({"fleet": {"bogus_knob": 1}}))
+
+
+def test_example_config_ships_a_fleet_block():
+    import pathlib
+
+    raw = (pathlib.Path(__file__).parent.parent /
+           "MiningConfig.example.json").read_text()
+    cfg = load_config(raw)
+    assert not cfg.fleet.enabled   # out of the box: single node
+    assert cfg.fleet.workers == 2 and cfg.fleet.lease_db
+
+
+def test_db_busy_timeout_validated():
+    with pytest.raises(ConfigError, match="db_busy_timeout_ms"):
+        load_config(json.dumps({"db_busy_timeout_ms": -5}))
+
+
+# -- NodeDB satellites -----------------------------------------------------
+
+def test_nodedb_sets_wal_and_busy_timeout(tmp_path):
+    from arbius_tpu.node import NodeDB
+
+    db = NodeDB(str(tmp_path / "n.sqlite"), busy_timeout_ms=777)
+    assert db._conn.execute("PRAGMA journal_mode").fetchone()[0] == "wal"
+    assert db._conn.execute("PRAGMA busy_timeout").fetchone()[0] == 777
+    db.close()
+
+
+def test_nodedb_count_jobs(tmp_path):
+    from arbius_tpu.node import NodeDB
+
+    db = NodeDB(":memory:")
+    db.queue_job("task", {"taskid": "0x1"}, concurrent=True)
+    db.queue_job("solve", {"taskid": "0x1"})
+    db.queue_job("claim", {"taskid": "0x1"}, waituntil=10**9)
+    assert db.count_jobs(("task", "solve", "pinTaskInput")) == 2
+    assert db.count_jobs(("claim",)) == 1
+    db.close()
+
+
+# -- obs satellite: labeled callback gauges --------------------------------
+
+def test_labeled_callback_gauge():
+    from arbius_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    source = {"pending": 3, "leased": 2}
+    g = reg.gauge("arbius_fleet_leases", "leases by state",
+                  labelnames=("state",), fn=lambda: source)
+    assert g.value(state="pending") == 3.0
+    assert g.value(state="nope") == 0.0
+    rendered = reg.render()
+    assert 'arbius_fleet_leases{state="leased"} 2' in rendered
+    assert 'arbius_fleet_leases{state="pending"} 3' in rendered
+    assert g.summary() == {"state=leased": 2.0, "state=pending": 3.0}
+    source["done"] = 9   # collect-time: the NEXT scrape sees it
+    assert g.value(state="done") == 9.0
+
+
+def test_labeled_callback_gauge_survives_dead_source():
+    from arbius_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+
+    def dead():
+        raise RuntimeError("closed handle")
+
+    g = reg.gauge("arbius_dead", "x", labelnames=("state",), fn=dead)
+    v = g.value(state="x")
+    assert v != v   # NaN: a dead source must not look like "drained"
+    assert "arbius_dead NaN" in reg.render()   # scrape does not explode
+
+
+def test_release_by_stale_holder_is_rejected(table):
+    """A worker whose expired lease was stolen must not flip the
+    thief's LIVE lease: release is holder-checked (the fleet-partition
+    race a non-atomic held()→release() pair can hit)."""
+    table.add_task("0xaa", "0xm", 1, 100, 100)
+    table.acquire("worker-0", now=100, ttl=30, limit=1)
+    table.acquire("worker-1", now=140, ttl=30, limit=1)   # the steal
+    assert table.release("0xaa", "worker-0", now=141,
+                         max_attempts=1) == "stolen"
+    # worker-1's lease untouched — still live, still theirs
+    assert table.held("worker-1") == ["0xaa"]
+    assert table.counts() == {"leased": 1}
+
+
+def test_geth_shape_nonce_errors_classify_as_engine_errors():
+    from arbius_tpu.chain import EngineError
+    from arbius_tpu.chain.rpc_client import RpcError
+    from arbius_tpu.node.rpc_chain import (
+        ChainRpcError,
+        _engine_error,
+        is_nonce_error,
+        nonce_conflict,
+    )
+
+    for msg in ("nonce too low: next nonce 3, tx nonce 5",
+                "nonce too high", "replacement transaction underpriced",
+                "already known"):
+        e = RpcError("{...}", code=-32000, message=msg)
+        assert is_nonce_error(e), msg
+        assert isinstance(_engine_error(e), EngineError), msg
+    # the phrases guard the MESSAGE field only — echoed calldata in
+    # data (or an empty message falling back to the payload) never
+    # classifies
+    e = RpcError("{'data': 'nonce too low revert poem'}", code=-32000,
+                 message="", data="nonce too low revert poem")
+    assert not is_nonce_error(e) and nonce_conflict(e) is None
+    assert isinstance(_engine_error(e), ChainRpcError)

@@ -481,3 +481,47 @@ def test_boot_gates_nondefault_attention_impl():
     finally:
         flash.set_attention_impl(prior)
     assert flash.attention_impl() == prior
+
+
+def test_get_jobs_orders_priority_desc_then_id_asc():
+    """The fleet reclaim path leans on this ordering (docs/fleet.md):
+    priority DESC, insertion id ASC on ties — a re-queued job never
+    jumps ahead of an older sibling at the same priority."""
+    from arbius_tpu.node import NodeDB
+
+    db = NodeDB(":memory:")
+    ids = [db.queue_job("a", {"n": i}) for i in range(3)]          # prio 0
+    hi = db.queue_job("hot", {}, priority=50)
+    mid = db.queue_job("warm", {}, priority=10)
+    jobs = db.get_jobs(now=0)
+    assert [j.id for j in jobs] == [hi, mid] + ids
+    # ties keep insertion order even after interleaved deletes
+    db.delete_job(ids[1])
+    assert [j.data.get("n") for j in db.get_jobs(now=0)
+            if j.method == "a"] == [0, 2]
+    db.close()
+
+
+def test_get_jobs_limit_boundary_exactly_hit():
+    from arbius_tpu.node import NodeDB
+
+    db = NodeDB(":memory:")
+    for i in range(101):
+        db.queue_job("a", {"n": i})
+    assert len(db.get_jobs(now=0)) == 100          # default limit
+    assert len(db.get_jobs(now=0, limit=101)) == 101
+    assert len(db.get_jobs(now=0, limit=1)) == 1
+    db.close()
+
+
+def test_get_jobs_excludes_future_waituntil():
+    from arbius_tpu.node import NodeDB
+
+    db = NodeDB(":memory:")
+    due = db.queue_job("now", {}, waituntil=100)
+    edge = db.queue_job("edge", {}, waituntil=200)
+    db.queue_job("later", {}, waituntil=201)
+    assert [j.id for j in db.get_jobs(now=100)] == [due]
+    # waituntil == now is DUE (<=), one second later is not
+    assert [j.id for j in db.get_jobs(now=200)] == [due, edge]
+    db.close()

@@ -268,3 +268,65 @@ def test_rpc_chain_full_commit_reveal_claim():
     chain.claim_solution(tid)
     assert eng.solutions[next(iter(eng.tasks))].claimed
     assert chain.token_balance() >= before
+
+
+def test_nonce_conflict_parsed_structurally():
+    """The satellite fix: classification reads the error MESSAGE field
+    (devnet shape `nonce N != expected M`), never a substring scan of
+    the stringified payload — calldata echoed in `data` that happens to
+    contain the word "nonce" must classify as a transport fault."""
+    from arbius_tpu.chain import EngineError
+    from arbius_tpu.chain.rpc_client import RpcError
+    from arbius_tpu.node.rpc_chain import (
+        ChainRpcError,
+        _engine_error,
+        nonce_conflict,
+    )
+
+    # the devnet's exact rejection (FaultTransport re-wraps it raw)
+    e = RpcError("nonce 5 != expected 3")
+    assert nonce_conflict(e) == (5, 3)
+    assert isinstance(_engine_error(e), EngineError)
+
+    # structured JSON-RPC error object: message carries the sentence
+    e = RpcError("{'code': -32000, ...}", code=-32000,
+                 message="err: nonce 12 != expected 11")
+    assert nonce_conflict(e) == (12, 11)
+    assert isinstance(_engine_error(e), EngineError)
+
+    # a task payload echoing "nonce" in the DATA is NOT a conflict
+    e = RpcError("server error", code=-32000,
+                 message="internal failure",
+                 data='{"input": "write a poem about a nonce"}')
+    assert nonce_conflict(e) is None
+    assert isinstance(_engine_error(e), ChainRpcError)
+
+    # nor is a malformed almost-match in the message itself
+    assert nonce_conflict(RpcError("nonce mismatch somewhere")) is None
+    # reverts still classify as engine errors
+    assert isinstance(_engine_error(RpcError("execution revert: no")),
+                      EngineError)
+
+
+def test_devnet_nonce_rejection_classifies_via_transport():
+    """End to end through the live transport wrapper: a wrong-nonce tx
+    into the devnet surfaces as EngineError (state-dependent retry),
+    not as a retryable transport fault."""
+    from arbius_tpu.chain import EngineError
+    from arbius_tpu.chain.rlp import Eip1559Tx
+    from arbius_tpu.node.rpc_chain import _engine_error, nonce_conflict
+    from arbius_tpu.chain.rpc_client import RpcError
+
+    eng, dev, miner, user, mid = make_world()
+    tx = Eip1559Tx(chain_id=CHAIN_ID, nonce=9, max_priority_fee_per_gas=1,
+                   max_fee_per_gas=10, gas_limit=100000,
+                   to=dev.engine_address, value=0, data=b"")
+    raw = tx.sign(miner)
+    try:
+        DevnetTransport(dev).request("eth_sendRawTransaction",
+                                     ["0x" + raw.hex()])
+    except RpcError as e:
+        assert nonce_conflict(e) == (9, 0)
+        assert isinstance(_engine_error(e), EngineError)
+    else:
+        raise AssertionError("wrong nonce was accepted")

@@ -10,6 +10,8 @@ against the protocol invariants.
     python tools/simsoak.py                          # clean, seed 0
     python tools/simsoak.py --scenario tier1 --seeds 2   # the CI matrix
     python tools/simsoak.py --scenario chaos --seed 41 --json
+    python tools/simsoak.py --scenario fleet-race    # 2-miner fleet
+    python tools/simsoak.py --flood 10000            # 10k-task fleet soak
     python tools/simsoak.py --list                   # scenario catalog
     python tools/simsoak.py --inject-bug double-commit   # must exit 1
 
