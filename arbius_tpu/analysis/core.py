@@ -370,4 +370,5 @@ def load_builtin_rules() -> None:
         rules_concurrency,
         rules_determinism,
         rules_jit,
+        rules_obs,
     )

@@ -28,8 +28,11 @@ from arbius_tpu.analysis.core import AnalysisError, Finding
 from arbius_tpu.models.trace_specs import TraceSpec
 from arbius_tpu.obs import current_obs
 
-# sub-second tiny-model traces up to minutes-scale full-topology ones
-TRACE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+# sub-second tiny-model traces up to minutes-scale full-topology ones;
+# the edge set is centralized in obs.registry (docs/fleetscope.md) so
+# federated merges can rely on every process sharing it — re-exported
+# here for the existing import surface
+from arbius_tpu.obs.registry import TRACE_BUCKETS  # noqa: F401
 
 
 @dataclass

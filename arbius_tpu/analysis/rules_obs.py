@@ -1,0 +1,94 @@
+"""detlint observability rule (OBS5xx): metric-name ↔ doc drift.
+
+docs/observability.md is the operator's contract: every `arbius_*`
+metric the tree can expose has a row there explaining what it means.
+Nothing enforced that until now — a PR could register a new counter
+and the doc would silently rot (it nearly happened twice in the fleet
+PRs). OBS501 closes the loop:
+
+  OBS501  a literal `arbius_*` metric name passed to a registry
+          constructor (`.counter(...)` / `.gauge(...)` /
+          `.histogram(...)`) anywhere under `arbius_tpu/` has no
+          matching token in docs/observability.md — doc drift fails
+          the lint. Fix by adding the doc row (or renaming the metric);
+          a deliberate exception takes the usual reason-mandatory
+          `# detlint: allow[OBS501] why` pragma.
+
+Honesty bounds: only STRING LITERAL names are checked (an f-string like
+`f"arbius_{name}_total"` names a family, not a metric — its members are
+documented as explicit rows); only attribute calls named exactly
+counter/gauge/histogram are matched, the shape every registry call site
+in this repo uses. The documented-name set is the `arbius_[a-z0-9_]+`
+tokens of docs/observability.md, read once per process — file content,
+never filesystem order, so the rule stays deterministic.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from arbius_tpu.analysis.core import FileContext, rule
+
+_REGISTRY_METHODS = ("counter", "gauge", "histogram")
+_TOKEN = re.compile(r"\barbius_[a-z0-9_]+\b")
+
+# repo root resolved from this module (arbius_tpu/analysis/rules_obs.py)
+_DOC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))),
+    "docs", "observability.md")
+
+_documented: dict[str, set[str]] = {}
+
+
+def documented_metric_names(path: str = _DOC_PATH) -> set[str]:
+    """Every arbius_* token in docs/observability.md (cached per PATH —
+    a caller naming a different doc gets that doc, not the first one
+    loaded). A missing doc reads as an empty set — every metric then
+    flags, which is the correct fail-closed posture for a deleted
+    contract."""
+    cached = _documented.get(path)
+    if cached is None:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                cached = set(_TOKEN.findall(fh.read()))
+        except OSError:
+            cached = set()
+        _documented[path] = cached
+    return cached
+
+
+def _literal_name(call: ast.Call) -> ast.Constant | None:
+    node = call.args[0] if call.args else None
+    if node is None:
+        for kw in call.keywords:
+            if kw.arg == "name":
+                node = kw.value
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node
+    return None
+
+
+@rule("OBS501", "error",
+      "registered arbius_* metric has no row in docs/observability.md")
+def undocumented_metric(ctx: FileContext):
+    """Doc-drift gate, scoped to the shipped tree: registry calls in
+    tests/tools may name throwaway metrics freely."""
+    if not ctx.path.startswith("arbius_tpu/"):
+        return
+    documented = documented_metric_names()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REGISTRY_METHODS):
+            continue
+        name = _literal_name(node)
+        if name is None or not name.value.startswith("arbius_"):
+            continue
+        if name.value not in documented:
+            yield (node.lineno, node.col_offset,
+                   f"metric `{name.value}` is registered here but has "
+                   "no row in docs/observability.md — add the row (or "
+                   "rename); the operator doc is a contract, not a "
+                   "suggestion")

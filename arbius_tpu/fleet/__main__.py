@@ -42,7 +42,20 @@ def build_chain(deployment, key_hex: str, *, tx_guard=None):
                     start_block=deployment.start_block)
 
 
-def run_coordinator(cfg, deployment, key_hex: str, *, stop=None) -> None:
+def _make_sidecar(cfg, member: str, obs):
+    """fleetscope sidecar for this member when `fleet.sidecar_dir` is
+    configured (docs/fleetscope.md); None = fleetscope off."""
+    if not cfg.fleet.sidecar_dir:
+        return None
+    from arbius_tpu.obs.fleetscope import ObsSidecar, sidecar_path
+
+    os.makedirs(cfg.fleet.sidecar_dir, exist_ok=True)
+    return ObsSidecar(sidecar_path(cfg.fleet.sidecar_dir, member),
+                      member, obs)
+
+
+def run_coordinator(cfg, deployment, key_hex: str, *, stop=None,
+                    metrics_port: int | None = None) -> None:
     from arbius_tpu.fleet import FleetCoordinator, LeaseTable
 
     leases = LeaseTable(cfg.fleet.lease_db, cfg.fleet.busy_timeout_ms)
@@ -50,9 +63,28 @@ def run_coordinator(cfg, deployment, key_hex: str, *, stop=None) -> None:
     coord = FleetCoordinator(chain, leases,
                              [m.id for m in cfg.models if m.enabled],
                              cfg.fleet)
+    coord.sidecar = _make_sidecar(cfg, "coordinator", coord.obs)
+    server = None
+    if metrics_port is not None:
+        # the federated scrape (docs/fleetscope.md): one GET /metrics
+        # for the whole fleet, merged from the sidecars + the
+        # coordinator's own live registry
+        if not cfg.fleet.sidecar_dir:
+            raise SystemExit("--metrics-port needs fleet.sidecar_dir "
+                             "(the federated view merges the sidecars)")
+        from arbius_tpu.obs.fleetscope import FleetMetricsServer
+
+        server = FleetMetricsServer(cfg.fleet.sidecar_dir, coord.obs,
+                                    port=metrics_port)
+        server.start()
     try:
         coord.run(stop=stop)
     finally:
+        if server is not None:
+            server.stop()
+        if coord.sidecar is not None:
+            coord.sidecar.flush(coord.chain.now)
+            coord.sidecar.close()
         leases.close()
 
 
@@ -75,11 +107,17 @@ def run_worker(cfg, deployment, key_hex: str, worker_index: int, *,
                 if cfg.db_path != ":memory:" else ":memory:",
                 busy_timeout_ms=cfg.db_busy_timeout_ms)
     node = MinerNode(chain, cfg, registry, db=db)
-    LeaseFeed(leases, worker_id, cfg.fleet).attach(node)
+    feed = LeaseFeed(leases, worker_id, cfg.fleet).attach(node)
+    sidecar = _make_sidecar(cfg, worker_id, node.obs)
+    if sidecar is not None:
+        feed.attach_sidecar(sidecar, every=cfg.fleet.sidecar_flush_every)
     try:
         node.boot()
         node.run(stop=stop)
     finally:
+        if sidecar is not None:
+            feed.flush_sidecar(node.chain.now)
+            sidecar.close()
         node.close()
         leases.close()
 
@@ -96,6 +134,10 @@ def main(argv=None) -> int:
                    help="DeploymentConfig JSON (chain endpoint)")
     p.add_argument("--worker-id", type=int, default=0,
                    help="worker index (role=worker; unique per process)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="role=coordinator: serve the federated fleet "
+                        "GET /metrics on this port (needs "
+                        "fleet.sidecar_dir — docs/fleetscope.md)")
     ns = p.parse_args(argv)
 
     from arbius_tpu.node.config import (
@@ -124,7 +166,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     if ns.role == "coordinator":
-        run_coordinator(cfg, deployment, key)
+        run_coordinator(cfg, deployment, key,
+                        metrics_port=ns.metrics_port)
     else:
         run_worker(cfg, deployment, key, ns.worker_id)
     return 0

@@ -29,11 +29,16 @@ log = logging.getLogger("arbius.fleet")
 
 class FleetCoordinator:
     def __init__(self, chain, leases: LeaseTable, model_ids,
-                 config: FleetConfig, obs=None):
+                 config: FleetConfig, obs=None, sidecar=None):
         self.chain = chain
         self.leases = leases
         self.model_ids = set(model_ids)
         self.config = config
+        # fleetscope sidecar (docs/fleetscope.md): the coordinator's
+        # own registry/journal persist alongside the workers' so the
+        # federated view covers the deal side of every trace chain
+        self.sidecar = sidecar
+        self._ticks = 0
         if obs is None:
             from arbius_tpu.obs import Obs
 
@@ -81,6 +86,10 @@ class FleetCoordinator:
             for taskid, dead, lag in reclaimed:
                 log.info("lease %s reclaimed from %s (%ds past its "
                          "heartbeat)", taskid, dead, lag)
+            self._ticks += 1
+            if self.sidecar is not None and \
+                    self._ticks % self.config.sidecar_flush_every == 0:
+                self.sidecar.flush(self.chain.now)
             return len(reclaimed)
 
     def run(self, *, stop=None) -> None:

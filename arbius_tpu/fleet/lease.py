@@ -30,6 +30,17 @@ workers never double-commit one `(validator, taskid)` — and a holder
 whose lease was reclaimed loses its rights to the reclaimer (the
 crashed-after-commit worker's task must still be finishable).
 
+Trace propagation (docs/fleetscope.md): every lease row carries a
+`hops` JSON chain — the coordinator's `deal` plus every `acquire` /
+`steal` / `reclaim` hop, stamped with the acting worker, chain time,
+and a contiguous hop index assigned inside the same transaction that
+performs the transition. Workers adopt their hop into their own obs
+journal (`lease_hop`, worker.py), so one task's lifecycle is a single
+gap-free span chain across processes even through a steal — SIM112
+audits exactly this, and `arbius_fleet_queue_wait_seconds` /
+`arbius_fleet_time_to_commit_seconds` (fixed chain-second buckets, the
+SLO substrate) are observed at the same transitions.
+
 Everything is keyed on chain time (`now` is always passed in) and
 insertion rowids — no wall clock, no host randomness — so a fleet run
 is deterministic for a fixed event stream.
@@ -37,12 +48,14 @@ is deterministic for a fixed event stream.
 # detlint: enforce[DET101,DET102,DET103,DET105]
 from __future__ import annotations
 
+import json
 import sqlite3
 import threading
 from contextlib import contextmanager
 from dataclasses import dataclass
 
 from arbius_tpu.obs import current_obs
+from arbius_tpu.obs.registry import CHAIN_SECONDS_BUCKETS
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS leases (
@@ -50,7 +63,7 @@ CREATE TABLE IF NOT EXISTS leases (
     taskid TEXT UNIQUE, model TEXT, fee TEXT, blocktime INT,
     state TEXT, worker TEXT DEFAULT '', expires INT DEFAULT 0,
     acquired INT DEFAULT 0, attempts INT DEFAULT 0,
-    steals INT DEFAULT 0);
+    steals INT DEFAULT 0, hops TEXT DEFAULT '[]');
 CREATE TABLE IF NOT EXISTS fleet_commits (
     taskid TEXT PRIMARY KEY, validator TEXT, worker TEXT, cid TEXT);
 CREATE TABLE IF NOT EXISTS fleet_wallet (
@@ -94,6 +107,22 @@ class LeaseGrant:
     blocktime: int
     attempts: int
     stolen: bool          # reclaimed from another worker's expired lease
+    # this grant's index in the task's cross-process trace-hop chain
+    # (assigned in the claim transaction; the worker journals its
+    # adoption as a `lease_hop` event — docs/fleetscope.md)
+    hop: int = 0
+
+
+def _hop(hops_json: str, op: str, worker: str, now: int,
+         **extra) -> tuple[str, int]:
+    """Append one hop to a row's JSON chain; returns (new chain JSON,
+    the appended hop's index). The index is the prior chain length, so
+    indices stay contiguous by construction."""
+    hops = json.loads(hops_json or "[]")
+    index = len(hops)
+    hops.append(dict({"hop": index, "op": op, "worker": worker,
+                      "now": now}, **extra))
+    return json.dumps(hops, sort_keys=True), index
 
 
 class LeaseTable:
@@ -117,6 +146,14 @@ class LeaseTable:
             # executescript manages its own transaction (and would
             # auto-commit an explicit BEGIN around it)
             self._conn.executescript(_SCHEMA)
+            # pre-fleetscope lease files lack the trace-hop column; the
+            # table re-derives from the chain either way, so migrating
+            # in place is strictly additive
+            cols = {r["name"] for r in self._conn.execute(
+                "PRAGMA table_info(leases)")}
+            if "hops" not in cols:
+                self._conn.execute("ALTER TABLE leases ADD COLUMN"
+                                   " hops TEXT DEFAULT '[]'")
 
     def close(self) -> None:
         # detlint: allow[CONC404] teardown-only, mirrors NodeDB.close:
@@ -158,8 +195,9 @@ class LeaseTable:
         with self._txn() as conn:
             cur = conn.execute(
                 "INSERT OR IGNORE INTO leases (taskid, model, fee,"
-                " blocktime, state) VALUES (?,?,?,?,'pending')",
-                (taskid, model, str(fee), blocktime))
+                " blocktime, state, hops) VALUES (?,?,?,?,'pending',?)",
+                (taskid, model, str(fee), blocktime,
+                 _hop("[]", "deal", "", now)[0]))
             fresh = cur.rowcount > 0
         if fresh:
             self._note("pending", taskid, "", now)
@@ -175,33 +213,71 @@ class LeaseTable:
         if limit <= 0:
             return []
         grants: list[LeaseGrant] = []
+        queue_waits: list[tuple[str, int]] = []
+        steal_lags: list[tuple[str, int]] = []
         with self._txn() as conn:
             rows = conn.execute(
                 "SELECT id, taskid, model, fee, blocktime, state, worker,"
-                " expires, attempts FROM leases WHERE state = 'pending'"
+                " expires, attempts, hops FROM leases"
+                " WHERE state = 'pending'"
                 " OR (state = 'leased' AND expires < ?)"
                 " ORDER BY id LIMIT ?", (now, limit)).fetchall()
             for r in rows:
                 stolen = r["state"] == "leased" and r["worker"] != worker
+                extra = {"lag": now - int(r["expires"])} if stolen else {}
+                hops, hop_index = _hop(
+                    r["hops"], "steal" if stolen else "acquire",
+                    worker, now, **extra)
                 conn.execute(
                     "UPDATE leases SET state='leased', worker=?,"
                     " expires=?, acquired=?, attempts=attempts+1,"
-                    " steals=steals+? WHERE id=?",
-                    (worker, now + ttl, now, int(stolen), r["id"]))
+                    " steals=steals+?, hops=? WHERE id=?",
+                    (worker, now + ttl, now, int(stolen), hops, r["id"]))
                 grants.append(LeaseGrant(
                     taskid=r["taskid"], model=r["model"],
                     fee=int(r["fee"]), blocktime=int(r["blocktime"]),
-                    attempts=int(r["attempts"]) + 1, stolen=stolen))
+                    attempts=int(r["attempts"]) + 1, stolen=stolen,
+                    hop=hop_index))
+                if int(r["attempts"]) == 0 and r["state"] == "pending":
+                    # first delivery: deal → acquire is the task's
+                    # queue wait (the SLO corpus, docs/fleetscope.md)
+                    queue_waits.append((r["taskid"],
+                                        now - int(r["blocktime"])))
                 if stolen:
                     # lag from heartbeat expiry to the steal — SIM111's
                     # reclaimed-within-ttl audit reads this
+                    lag = now - int(r["expires"])
+                    steal_lags.append((r["taskid"], lag))
                     self.history.append((
                         "steal", r["taskid"], worker, now,
-                        {"from": r["worker"],
-                         "lag": now - int(r["expires"])}))
+                        {"from": r["worker"], "lag": lag}))
         for g in grants:
             self._note("leased", g.taskid, worker, now)
+        obs = current_obs()
+        if obs is not None:
+            for tid, wait in queue_waits:
+                obs.registry.histogram(
+                    "arbius_fleet_queue_wait_seconds",
+                    "Chain-seconds from the coordinator's deal to the "
+                    "first worker acquire (fixed chain-second buckets "
+                    "— the SLO substrate, docs/fleetscope.md)",
+                    buckets=CHAIN_SECONDS_BUCKETS).observe(wait, tag=tid)
+            for tid, lag in steal_lags:
+                self._observe_steal_lag(obs, tid, lag)
         return grants
+
+    @staticmethod
+    def _observe_steal_lag(obs, tid: str, lag: int) -> None:
+        """Steal/reclaim lag into the SLO corpus: chain-seconds an
+        expired lease lingered past its heartbeat before someone took
+        it back — the `slo.steal_lag_p99` objective's histogram."""
+        obs.registry.histogram(
+            "arbius_fleet_steal_lag_seconds",
+            "Chain-seconds an expired lease lingered past its "
+            "heartbeat before being stolen/reclaimed (fixed "
+            "chain-second buckets — the SLO substrate, "
+            "docs/fleetscope.md)",
+            buckets=CHAIN_SECONDS_BUCKETS).observe(lag, tag=tid)
 
     def heartbeat(self, worker: str, now: int, ttl: int) -> int:
         """Extend every lease `worker` still holds. Returns how many."""
@@ -230,8 +306,8 @@ class LeaseTable:
             raise ValueError(f"not a terminal lease state: {state!r}")
         with self._txn() as conn:
             row = conn.execute(
-                "SELECT acquired, state FROM leases WHERE taskid=?",
-                (taskid,)).fetchone()
+                "SELECT acquired, state, blocktime FROM leases"
+                " WHERE taskid=?", (taskid,)).fetchone()
             if row is None or row["state"] in TERMINAL_STATES:
                 return None
             conn.execute(
@@ -246,6 +322,19 @@ class LeaseTable:
                 "arbius_fleet_lease_age_seconds",
                 "Chain-seconds from lease acquisition to settlement "
                 "(docs/fleet.md)").observe(age, tag=taskid)
+            if state == "done":
+                # deal → solved-on-chain, as observed at settlement:
+                # the fleet's time-to-commit corpus (docs/fleetscope.md;
+                # the flood report derives the exact solution-blocktime
+                # version from the engine — this is the live-scrape one)
+                obs.registry.histogram(
+                    "arbius_fleet_time_to_commit_seconds",
+                    "Chain-seconds from the coordinator's deal to the "
+                    "task's solution being observed settled (fixed "
+                    "chain-second buckets — the SLO substrate, "
+                    "docs/fleetscope.md)",
+                    buckets=CHAIN_SECONDS_BUCKETS).observe(
+                    now - int(row["blocktime"]), tag=taskid)
         return age
 
     def release(self, taskid: str, worker: str, now: int,
@@ -286,19 +375,22 @@ class LeaseTable:
         out: list[tuple] = []
         with self._txn() as conn:
             rows = conn.execute(
-                "SELECT taskid, worker, expires, attempts FROM leases"
+                "SELECT taskid, worker, expires, attempts, hops"
+                " FROM leases"
                 " WHERE state='leased' AND expires < ? ORDER BY id",
                 (now,)).fetchall()
             for r in rows:
                 state = "failed" if int(r["attempts"]) >= max_attempts \
                     else "pending"
+                lag = now - int(r["expires"])
                 conn.execute(
-                    "UPDATE leases SET state=?, worker=?, steals=steals+1"
-                    " WHERE taskid=?",
+                    "UPDATE leases SET state=?, worker=?,"
+                    " steals=steals+1, hops=? WHERE taskid=?",
                     (state, "" if state == "pending" else r["worker"],
+                     _hop(r["hops"], "reclaim", "", now,
+                          source=r["worker"], lag=lag)[0],
                      r["taskid"]))
-                out.append((r["taskid"], r["worker"],
-                            now - int(r["expires"])))
+                out.append((r["taskid"], r["worker"], lag))
         for taskid, dead, lag in out:
             self.history.append(("reclaim", taskid, dead, now,
                                  {"lag": lag}))
@@ -308,6 +400,7 @@ class LeaseTable:
                     "arbius_fleet_reclaims_total",
                     "Expired leases swept back to pending by the "
                     "coordinator (docs/fleet.md)").inc()
+                self._observe_steal_lag(obs, taskid, lag)
         return out
 
     # -- cross-process commit dedupe -------------------------------------

@@ -46,6 +46,14 @@ class LeaseFeed:
         self.worker_id = worker_id
         self.config = config
         self._node = None
+        # fleetscope sidecar (docs/fleetscope.md), wired by
+        # attach_sidecar: the worker's registry snapshot + journal
+        # segments persist every `sidecar_flush_every` pumps so the
+        # coordinator's federated view (and tools/fleetscope.py) can
+        # merge this process's obs without talking to it
+        self._sidecar = None
+        self._flush_every = 1
+        self._pumps = 0
 
     def attach(self, node) -> "LeaseFeed":
         """Wire this feed into `node` (before boot): the node stops
@@ -55,6 +63,17 @@ class LeaseFeed:
         node.task_feed = self
         node.commit_guard = self.commit_guard
         return self
+
+    def attach_sidecar(self, sidecar, every: int = 1) -> "LeaseFeed":
+        """Flush `sidecar` every `every` pumps (plus on flush_sidecar —
+        harness/launcher teardown calls it for the final segment)."""
+        self._sidecar = sidecar
+        self._flush_every = max(1, int(every))
+        return self
+
+    def flush_sidecar(self, now: int = 0) -> None:
+        if self._sidecar is not None:
+            self._sidecar.flush(now)
 
     # -- the per-tick pump ------------------------------------------------
     def pump(self, node) -> int:
@@ -71,6 +90,10 @@ class LeaseFeed:
         for grant in self.leases.acquire(self.worker_id, now,
                                          cfg.lease_ttl, room):
             queued += self._ingest(node, grant, now)
+        self._pumps += 1
+        if self._sidecar is not None and \
+                self._pumps % self._flush_every == 0:
+            self.flush_sidecar(now)
         return queued
 
     def _settle(self, node, now: int) -> None:
@@ -95,8 +118,19 @@ class LeaseFeed:
     def _ingest(self, node, grant, now: int) -> int:
         """One leased task into the node's queue — the event handler's
         exact store+queue pair, so everything downstream (filter, gate,
-        hydration, solve, commit) is the single-node code path."""
+        hydration, solve, commit) is the single-node code path.
+
+        The FIRST thing every grant does — before any early return — is
+        journal its trace-hop adoption (`lease_hop`): the worker-side
+        half of the cross-process span chain the lease table's `hops`
+        column carries (docs/fleetscope.md). SIM112 cross-checks every
+        acquire/steal hop in the shared table against exactly this
+        event; sim/bugs.py's span-gap worker drops it and must fail
+        SIM112 alone."""
         tid = grant.taskid
+        node.obs.event("lease_hop", taskid=tid, worker=self.worker_id,
+                       hop=grant.hop,
+                       op="steal" if grant.stolen else "acquire")
         if node.chain.get_solution(tid) is not None:
             # raced: solved while pending (front-run or another fleet's
             # worker) — settle, never burn a solve on it

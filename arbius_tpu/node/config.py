@@ -145,6 +145,47 @@ class SchedConfig:
 
 
 @dataclass(frozen=True)
+class SLOConfig:
+    """First-class service-level objectives over the fleet's chain-time
+    latency corpus (docs/fleetscope.md): each threshold declares an
+    objective on a fixed-bucket percentile the SLO layer estimates
+    (`obs.registry.estimate_percentile`); `null` declares none. The
+    report always carries the percentiles — thresholds only decide
+    whether a soak/scrape FAILS on them (`simsoak --flood` exits 1 on
+    breach, SLO101)."""
+    # chain-seconds from the coordinator's deal to the first worker
+    # acquire, p95
+    queue_wait_p95: float | None = None
+    # chain-seconds from the task's entry into the fleet to its
+    # accepted solution, p99. Anchor detail (docs/fleetscope.md): the
+    # live histogram anchors on the coordinator's deal (the lease
+    # row's intake time — coordinator poll lag is excluded); the
+    # byte-deterministic flood report anchors on the exact on-chain
+    # submission blocktime. On a healthy coordinator the two agree to
+    # within one poll interval.
+    time_to_commit_p99: float | None = None
+    # chain-seconds an expired lease lingered past its heartbeat before
+    # being stolen/reclaimed, p99
+    steal_lag_p99: float | None = None
+    # ceiling on chip-idle wall seconds / total solve-path wall seconds
+    # (bench/live scrapes only — wall time never enters deterministic
+    # flood reports)
+    chip_idle_fraction: float | None = None
+
+    def __post_init__(self):
+        for name in ("queue_wait_p95", "time_to_commit_p99",
+                     "steal_lag_p99"):
+            v = getattr(self, name)
+            if v is not None and v < 0:
+                raise ConfigError(f"slo.{name} must be >= 0 seconds "
+                                  "(or null for no objective)")
+        f = self.chip_idle_fraction
+        if f is not None and not 0.0 <= f <= 1.0:
+            raise ConfigError("slo.chip_idle_fraction must be within "
+                              "[0, 1] (or null for no objective)")
+
+
+@dataclass(frozen=True)
 class FleetConfig:
     """Multi-process fleet mining (docs/fleet.md): a coordinator owns
     the chain event stream and leases tasks across N worker processes
@@ -178,8 +219,22 @@ class FleetConfig:
     max_attempts: int = 4
     # sqlite busy_timeout for lease-db handles (milliseconds)
     busy_timeout_ms: int = 5000
+    # fleetscope sidecar directory (docs/fleetscope.md): every fleet
+    # member persists registry snapshots + journal segments to its own
+    # `<member>.obs.sqlite` under this path, and the coordinator's
+    # federated GET /metrics merges them. Empty = fleetscope sidecars
+    # off (per-process obs only).
+    sidecar_dir: str = ""
+    # ticks between sidecar flushes (1 = every tick)
+    sidecar_flush_every: int = 8
 
     def __post_init__(self):
+        if self.sidecar_dir == ":memory:":
+            raise ConfigError("fleet.sidecar_dir must be a directory "
+                              "path — sidecars are merged across "
+                              "processes (empty string disables)")
+        if self.sidecar_flush_every < 1:
+            raise ConfigError("fleet.sidecar_flush_every must be >= 1")
         if self.workers < 1:
             raise ConfigError("fleet.workers must be >= 1")
         if self.lease_ttl < 1:
@@ -281,6 +336,9 @@ class MiningConfig:
     # multi-process fleet mining (docs/fleet.md); default OFF = this
     # process is a bare single-node miner
     fleet: FleetConfig = FleetConfig()
+    # service-level objectives over the chain-time latency corpus
+    # (docs/fleetscope.md); all-null = report percentiles, fail nothing
+    slo: SLOConfig = SLOConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -374,8 +432,9 @@ def load_config(raw: str | dict) -> MiningConfig:
     pipeline = build(PipelineConfig, obj.pop("pipeline", {}), "pipeline")
     sched = build(SchedConfig, obj.pop("sched", {}), "sched")
     fleet = build(FleetConfig, obj.pop("fleet", {}), "fleet")
+    slo = build(SLOConfig, obj.pop("slo", {}), "slo")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
                       ipfs=ipfs, pipeline=pipeline, sched=sched,
-                      fleet=fleet, **obj),
+                      fleet=fleet, slo=slo, **obj),
                  "config")

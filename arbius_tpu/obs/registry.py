@@ -22,9 +22,76 @@ import threading
 from bisect import bisect_left
 from collections import deque
 
+# -- centralized bucket-edge sets (docs/fleetscope.md) ----------------------
+#
+# Histograms that must MERGE across fleet processes (metrics federation)
+# must share bucket edges exactly — `merge_bucket_counts` refuses a
+# mismatch instead of silently producing garbage percentiles — so the
+# edge sets are named HERE, never improvised per call site.
+
 # latency-shaped default: sub-ms RPC spans up to multi-minute video solves
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+# graphlint spec-trace wall time (re-exported by analysis.graph.trace)
+TRACE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+# chain-time latency corpus (integer chain seconds): queue-wait,
+# time-to-commit, steal lag — the SLO substrate (docs/fleetscope.md)
+CHAIN_SECONDS_BUCKETS = (1.0, 2.0, 5.0, 10.0, 20.0, 30.0, 60.0, 120.0,
+                         300.0, 600.0, 1200.0, 1800.0, 3600.0)
+
+BUCKET_EDGES = {
+    "latency": DEFAULT_BUCKETS,
+    "trace": TRACE_BUCKETS,
+    "chain_seconds": CHAIN_SECONDS_BUCKETS,
+}
+
+
+def estimate_percentile(edges, counts, q: float) -> float | None:
+    """Percentile estimate from fixed-bucket counts (Prometheus
+    histogram_quantile semantics): linear interpolation inside the
+    bucket holding the target rank; the open +Inf bucket clamps to the
+    top finite edge; None when empty. This estimator — not the exact
+    recent-window `percentile()` — is the federation-safe one: bucket
+    counts merge losslessly across processes while bounded raw-sample
+    windows do not (docs/fleetscope.md)."""
+    edges = tuple(float(e) for e in edges)
+    counts = list(counts)
+    if len(counts) != len(edges) + 1:
+        raise ValueError(
+            f"counts length {len(counts)} != {len(edges)} edges + the "
+            "+Inf bucket — not a fixed-bucket count array")
+    total = sum(counts)
+    if total <= 0:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * total
+    cum = 0
+    for i, n in enumerate(counts):
+        if n > 0 and cum + n >= rank:
+            if i >= len(edges):
+                return edges[-1]  # open bucket: clamp to top finite edge
+            lo = edges[i - 1] if i > 0 else 0.0
+            return lo + (edges[i] - lo) * max(0.0, (rank - cum) / n)
+        cum += n
+    return edges[-1]
+
+
+def merge_bucket_counts(edges_a, counts_a, edges_b, counts_b) -> list:
+    """Elementwise-merge two fixed-bucket count arrays. REJECTS
+    mismatched edge sets: interpolating percentiles over silently
+    re-binned counts is exactly the garbage this error prevents."""
+    ta = tuple(float(e) for e in edges_a)
+    tb = tuple(float(e) for e in edges_b)
+    if ta != tb:
+        raise ValueError(
+            "refusing to merge histograms with mismatched bucket edges "
+            f"({len(ta)} edges vs {len(tb)}: {ta[:3]}… vs {tb[:3]}…) — "
+            "use one of the named sets in obs.registry.BUCKET_EDGES")
+    if len(counts_a) != len(counts_b):
+        raise ValueError("bucket count arrays differ in length")
+    return [a + b for a, b in zip(counts_a, counts_b)]
 
 
 def _fmt_value(v: float) -> str:
@@ -90,6 +157,10 @@ class _Metric:
         with self._lock:
             return sorted(self._children.items())
 
+    def _export_base(self) -> dict:
+        return {"kind": self.kind, "help": self.help,
+                "labelnames": list(self.labelnames)}
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -121,6 +192,13 @@ class Counter(_Metric):
             return self.value()
         return {",".join(f"{n}={v}" for n, v in zip(self.labelnames, key)):
                 c[0] for key, c in self._items()}
+
+    def export(self) -> dict:
+        """JSON-able snapshot for the fleetscope sidecar/federation
+        (docs/fleetscope.md): series as sorted [labelvalues, value]."""
+        return dict(self._export_base(),
+                    series=[[list(key), c[0]]
+                            for key, c in self._items()])
 
 
 class Gauge(_Metric):
@@ -224,6 +302,27 @@ class Gauge(_Metric):
         return {",".join(f"{n}={v}" for n, v in zip(self.labelnames, key)):
                 c[0] for key, c in self._items()}
 
+    def export(self) -> dict:
+        """Callback gauges are EVALUATED at export time (the sidecar
+        snapshot is a scrape); a dead labeled source exports
+        `dead: true` so the federated view renders the same bare
+        `name NaN` a local scrape would — federation must surface a
+        dead member's source, not silently drop its series."""
+        out = self._export_base()
+        if self.fn is not None:
+            if not self.labelnames:
+                out["series"] = [[[], self._call_fn()]]
+                return out
+            items = self._fn_items()
+            if items is None:
+                out["series"] = []
+                out["dead"] = True
+                return out
+            out["series"] = [[list(key), v] for key, v in items]
+            return out
+        out["series"] = [[list(key), c[0]] for key, c in self._items()]
+        return out
+
 
 class _HistChild:
     __slots__ = ("counts", "sum", "count", "recent")
@@ -286,6 +385,35 @@ class Histogram(_Metric):
     def count(self, **labels) -> int:
         c = self._peek(labels)
         return c.count if c is not None else 0
+
+    def bucket_counts(self, **labels) -> list[int]:
+        """Per-bucket (non-cumulative) counts incl. the +Inf bucket —
+        the mergeable form the federation layer ships between
+        processes (docs/fleetscope.md)."""
+        c = self._peek(labels)
+        if c is None:
+            return [0] * (len(self.buckets) + 1)
+        with self._lock:
+            return list(c.counts)
+
+    def estimate_percentile(self, q: float, **labels) -> float | None:
+        """Bucket-estimated percentile (module-level
+        `estimate_percentile` over this histogram's fixed edges):
+        unlike `percentile()` it never truncates to the recent window,
+        so it stays truthful at soak scale and federates across
+        processes."""
+        return estimate_percentile(self.buckets,
+                                   self.bucket_counts(**labels), q)
+
+    def export(self) -> dict:
+        out = self._export_base()
+        out["buckets"] = [float(b) for b in self.buckets]
+        series = []
+        for key, c in self._items():
+            with self._lock:
+                series.append([list(key), list(c.counts), c.sum, c.count])
+        out["series"] = series
+        return out
 
     def percentile(self, q: float, **labels) -> float | None:
         """Exact percentile over the recent window (numpy 'linear'
@@ -415,3 +543,57 @@ class MetricsRegistry:
     def summary(self) -> dict:
         """Compact JSON-able snapshot: {name: scalar | per-label dict}."""
         return {m.name: m.summary() for m in self._sorted()}
+
+    def export(self) -> dict:
+        """Full JSON-able registry snapshot for the fleetscope sidecar:
+        every metric's kind/help/labelnames plus its raw series —
+        counters/gauges as values, histograms as bucket counts — the
+        lossless mergeable form `fleetscope.merge_exports` federates
+        (docs/fleetscope.md)."""
+        return {"version": 1,
+                "metrics": {m.name: m.export() for m in self._sorted()}}
+
+
+def render_export(export: dict) -> str:
+    """Prometheus text exposition (0.0.4) from a registry export — the
+    SAME byte format `MetricsRegistry.render()` produces, so a
+    federated scrape and a local scrape are directly diffable. Metrics
+    render sorted by name; series keep their exported (sorted) order."""
+    out = []
+    metrics = export.get("metrics", {})
+    for name in sorted(metrics):
+        m = metrics[name]
+        kind = m.get("kind", "untyped")
+        labelnames = tuple(m.get("labelnames") or ())
+        if m.get("help"):
+            out.append(f"# HELP {name} {m['help']}")
+        out.append(f"# TYPE {name} {kind}")
+        series = m.get("series") or []
+        if kind == "histogram":
+            edges = m.get("buckets") or []
+            for key, counts, total, count in series:
+                cum = 0
+                for edge, n in zip(edges, counts):
+                    cum += n
+                    labels = _label_str(labelnames + ("le",),
+                                        tuple(key) + (_fmt_value(edge),))
+                    out.append(f"{name}_bucket{labels} {cum}")
+                labels = _label_str(labelnames + ("le",),
+                                    tuple(key) + ("+Inf",))
+                out.append(f"{name}_bucket{labels} {count}")
+                base = _label_str(labelnames, tuple(key))
+                out.append(f"{name}_sum{base} {_fmt_value(total)}")
+                out.append(f"{name}_count{base} {count}")
+            continue
+        if m.get("dead"):
+            # a labeled callback gauge whose source died anywhere in
+            # the fleet: the merged scrape must say so, exactly like a
+            # local scrape would — never an empty ("all drained") set
+            out.append(f"{name} NaN")
+            continue
+        lines = [f"{name}{_label_str(labelnames, tuple(key))} "
+                 f"{_fmt_value(v)}" for key, v in series]
+        if not lines and not labelnames:
+            lines = [f"{name} 0"]
+        out.extend(lines)
+    return "\n".join(out) + "\n"

@@ -109,12 +109,35 @@ class DoubleLeaseWorkerNode(MinerNode):
         return super().tick()
 
 
+class SpanGapWorkerNode(MinerNode):
+    """A fleet worker whose obs drops the `lease_hop` adoption events —
+    the worker-side half of the cross-process trace chain
+    (docs/fleetscope.md). Work still flows: leases are acquired, tasks
+    solve, CIDs land byte-identically, SIM101-111 all hold — but every
+    acquire/steal hop the shared lease table granted this worker is now
+    missing its journal adoption, so the task's span chain has a gap
+    only SIM112's trace-completeness audit can see. It must fail
+    closed, and fail ALONE."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        real_event = self.obs.event
+
+        def dropping(kind: str, **fields) -> None:
+            if kind == "lease_hop":
+                return  # the injected trace gap
+            real_event(kind, **fields)
+
+        self.obs.event = dropping
+
+
 INJECTABLE_BUGS = {
     "double-commit": DoubleCommitMinerNode,
     "racy-counter": RacyCounterMinerNode,
     "double-lease": DoubleLeaseWorkerNode,
+    "span-gap": SpanGapWorkerNode,
 }
 
 # bugs that only make sense inside a fleet (the CLI swaps the scenario
 # to a fleet one when needed)
-FLEET_BUGS = ("double-lease",)
+FLEET_BUGS = ("double-lease", "span-gap")

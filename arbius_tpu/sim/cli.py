@@ -10,6 +10,7 @@ once): exit 0 = every scenario run passed every invariant checker,
     python -m arbius_tpu.sim --scenario all --seeds 3 --json
     python -m arbius_tpu.sim --scenario fleet-race   # 2-miner fleet
     python -m arbius_tpu.sim --flood 10000           # 10k fleet soak
+    python -m arbius_tpu.sim --flood 10000 --slo time_to_commit_p99=300
     python -m arbius_tpu.sim --list                  # scenario catalog
     python -m arbius_tpu.sim --inject-bug double-commit   # must exit 1
 """
@@ -47,15 +48,22 @@ def build_arg_parser(p: argparse.ArgumentParser | None = None
     p.add_argument("--inject-bug", default=None,
                    help="run with a deliberately broken node (checker "
                         "regression); known: double-commit, "
-                        "racy-counter, double-lease")
+                        "racy-counter, double-lease, span-gap")
     p.add_argument("--flood", type=int, default=None, metavar="N",
                    help="fleet flood soak (docs/fleet.md): push N task "
                         "lifecycles through a fleet over the in-process "
                         "engine and audit bounded worker backlogs, "
-                        "lease settlement, and commit dedupe "
-                        "(e.g. --flood 10000)")
+                        "lease settlement, commit dedupe, and the "
+                        "byte-deterministic SLO percentile report "
+                        "(docs/fleetscope.md) (e.g. --flood 10000)")
     p.add_argument("--workers", type=int, default=4,
                    help="fleet size for --flood (default: 4)")
+    p.add_argument("--slo", default=None, metavar="K=V[,K=V...]",
+                   help="SLO thresholds for --flood (chain seconds; "
+                        "docs/fleetscope.md): queue_wait_p95, "
+                        "time_to_commit_p99, steal_lag_p99 — a "
+                        "breached objective fails the run (SLO101), "
+                        "e.g. --slo time_to_commit_p99=120")
     p.add_argument("--witness", action="store_true",
                    help="instrument the node with the conclint runtime "
                         "witness (docs/concurrency.md): SIM110 audits "
@@ -109,6 +117,12 @@ def collect(ns: argparse.Namespace):
             s = SCENARIOS[name]
             print(f"{name:15s} tasks={s.tasks:<3d} {s.description}")
         return EXIT_CLEAN, []
+    if ns.slo is not None and ns.flood is None:
+        # fail-closed: silently ignoring a declared objective is the
+        # exact bug the SLO layer exists to prevent
+        print("simsoak: --slo only applies to --flood (scenario runs "
+              "are audited by the SIM1xx invariants)", file=sys.stderr)
+        return EXIT_USAGE, []
     node_cls = MinerNode
     if ns.inject_bug is not None:
         node_cls = INJECTABLE_BUGS.get(ns.inject_bug)
@@ -122,11 +136,35 @@ def collect(ns: argparse.Namespace):
             print("simsoak: --flood and --workers must be >= 1",
                   file=sys.stderr)
             return EXIT_USAGE, []
+        from arbius_tpu.node.config import ConfigError, SLOConfig
         from arbius_tpu.sim.fleet import FleetFloodHarness, flood_findings
 
+        slo = SLOConfig()
+        if ns.slo is not None:
+            # only the chain-time objectives the deterministic flood
+            # report measures — accepting e.g. chip_idle_fraction here
+            # would "validate" an objective the run can never evaluate
+            flood_keys = ("queue_wait_p95", "time_to_commit_p99",
+                          "steal_lag_p99")
+            try:
+                kwargs = {}
+                for part in ns.slo.split(","):
+                    key, _, value = part.partition("=")
+                    key = key.strip()
+                    if key not in flood_keys:
+                        raise ValueError(
+                            f"{key!r} is not a --flood objective "
+                            f"(known: {', '.join(flood_keys)})")
+                    kwargs[key] = float(value)
+                slo = SLOConfig(**kwargs)
+            except (TypeError, ValueError, ConfigError) as e:
+                print(f"simsoak: bad --slo {ns.slo!r}: {e}",
+                      file=sys.stderr)
+                return EXIT_USAGE, []
         with tempfile.TemporaryDirectory(prefix="simflood-") as tmp:
             harness = FleetFloodHarness(ns.flood, ns.workers,
-                                        ns.workdir or tmp, seed=ns.seed)
+                                        ns.workdir or tmp, seed=ns.seed,
+                                        slo=slo)
             try:
                 ns._flood = harness.run()
             finally:
@@ -225,6 +263,19 @@ def render(ns: argparse.Namespace, findings, out) -> None:
             f"  sqlite commits per worker "
             f"{dict(sorted(flood['db_commits'].items()))} "
             f"(one fsync per tick, not per job)\n")
+        slo = flood.get("slo")
+        if slo is not None:
+            def _pcts(block):
+                return " ".join(
+                    f"{p}={block.get(p)}" for p in ("p50", "p95", "p99"))
+            out.write(
+                f"  slo {'OK' if slo.get('ok') else 'BREACHED'}: "
+                f"queue-wait [{_pcts(slo['queue_wait_seconds'])}] "
+                f"time-to-commit "
+                f"[{_pcts(slo['time_to_commit_seconds'])}] "
+                f"steal-lag [{_pcts(slo['steal_lag_seconds'])}] "
+                "(chain seconds, fixed-bucket estimate — "
+                "docs/fleetscope.md)\n")
     for r in runs:
         terminal = " ".join(f"{k}={v}" for k, v in r["terminal"].items())
         faults = sum(r["faults_injected"].values())

@@ -46,9 +46,15 @@ from arbius_tpu.node import (
     NodeDB,
     RegisteredModel,
 )
-from arbius_tpu.node.config import FleetConfig, PipelineConfig
+from arbius_tpu.node.config import FleetConfig, PipelineConfig, SLOConfig
 from arbius_tpu.node.rpc_chain import RpcChain
 from arbius_tpu.obs import use_obs
+from arbius_tpu.obs.fleetscope import (
+    ObsSidecar,
+    evaluate_slo,
+    latency_summary,
+    sidecar_path,
+)
 from arbius_tpu.sim.faults import (
     AuditedRpcChain,
     FaultTransport,
@@ -96,6 +102,8 @@ class FleetSimHarness(SimHarness):
                              "spec — use SimHarness")
         self.workdir = workdir
         self.workers: list[MinerNode] = []
+        self.feeds: list[LeaseFeed] = []
+        self.sidecars: list[ObsSidecar] = []
         self.leases: LeaseTable | None = None
         self.coordinator: FleetCoordinator | None = None
         self._ticks = 0
@@ -143,8 +151,18 @@ class FleetSimHarness(SimHarness):
         client = EngineRpcClient(transport, self.dev.engine_address,
                                  self.coord_wallet, chain_id=CHAIN_ID)
         chain = RpcChain(client, self.dev.token_address)
-        return FleetCoordinator(chain, self.leases, self.model_ids,
-                                self.fleet_cfg)
+        coord = FleetCoordinator(chain, self.leases, self.model_ids,
+                                 self.fleet_cfg)
+        # a restarted coordinator is a NEW obs stream (its journal seqs
+        # restart at 1), so each incarnation gets its own sidecar member
+        # name — federation sees the restart honestly instead of
+        # colliding seqs in one file (docs/fleetscope.md)
+        member = "coordinator" if self.result.restarts == 0 \
+            else f"coordinator-r{self.result.restarts}"
+        coord.sidecar = ObsSidecar(sidecar_path(self.workdir, member),
+                                   member, coord.obs)
+        self.sidecars.append(coord.sidecar)
+        return coord
 
     def _build_worker(self, index: int, wallet: Wallet) -> MinerNode:
         transport = FaultTransport(self.dev, self.plane)
@@ -178,8 +196,13 @@ class FleetSimHarness(SimHarness):
         node = self.node_cls(chain, cfg, registry, db=db, store=None,
                              pinner=SimPinner(self.plane))
         node._retry_sleep = self.clock.sleep
-        LeaseFeed(self.leases, make_worker_id(index),
-                  self.fleet_cfg).attach(node)
+        wid = make_worker_id(index)
+        feed = LeaseFeed(self.leases, wid, self.fleet_cfg).attach(node)
+        sidecar = ObsSidecar(sidecar_path(self.workdir, wid), wid,
+                             node.obs)
+        feed.attach_sidecar(sidecar, every=4)
+        self.feeds.append(feed)
+        self.sidecars.append(sidecar)
         node.boot(skip_self_test=True)
         return node
 
@@ -229,11 +252,25 @@ class FleetSimHarness(SimHarness):
         result = super().run()
         for worker in self.workers[1:]:
             result.journal_events.extend(worker.obs.journal.events())
+        result.journal_dropped = sum(w.obs.journal.dropped
+                                     for w in self.workers)
         result.worker_dbs = [w.db for w in self.workers]
         result.lease_rows = [dict(r) for r in self.leases.rows()]
         result.lease_history = list(self.leases.history)
         result.lease_counts = self.leases.counts()
         result.commit_rows = [dict(r) for r in self.leases.commit_rows()]
+        # final fleetscope flush: every member's last journal segment
+        # lands before federation reads the sidecars (the files stay on
+        # disk for post-mortems — result.sidecar_dir points at them)
+        now = self.clock.now
+        for feed in self.feeds:
+            feed.flush_sidecar(now)
+        if self.coordinator is not None and \
+                self.coordinator.sidecar is not None:
+            self.coordinator.sidecar.flush(now)
+        for sidecar in self.sidecars:
+            sidecar.close()
+        result.sidecar_dir = self.workdir
         return result
 
 
@@ -273,7 +310,8 @@ class FleetFloodHarness:
 
     def __init__(self, tasks: int, workers: int, workdir: str, *,
                  seed: int = 0, burst: int = 200, backlog: int = 64,
-                 max_leases: int = 32, canonical_batch: int = 4):
+                 max_leases: int = 32, canonical_batch: int = 4,
+                 slo: SLOConfig | None = None):
         import json
 
         from arbius_tpu.chain import Engine
@@ -285,6 +323,8 @@ class FleetFloodHarness:
         self.n_workers = workers
         self.seed = seed
         self.burst = burst
+        self.slo = slo if slo is not None else SLOConfig()
+        self.workdir = workdir
         self._json = json
         self.token = TokenLedger()
         self.engine = Engine(self.token, start_time=100_000)
@@ -314,6 +354,7 @@ class FleetFloodHarness:
             [self.model_id], self.fleet_cfg)
         runner = _FloodRunner()
         self.workers: list[MinerNode] = []
+        self._feeds: list[LeaseFeed] = []
         for i, a in enumerate(addrs):
             registry = ModelRegistry()
             registry.register(RegisteredModel(
@@ -328,8 +369,15 @@ class FleetFloodHarness:
                 LocalChain(self.engine, a), cfg, registry,
                 db=NodeDB(os.path.join(workdir, f"flood-{i}.sqlite")),
                 store=None, pinner=None)
-            LeaseFeed(self.leases, make_worker_id(i),
-                      self.fleet_cfg).attach(node)
+            wid = make_worker_id(i)
+            feed = LeaseFeed(self.leases, wid, self.fleet_cfg
+                             ).attach(node)
+            # flood sidecars flush ONLY at close (flood wall time is a
+            # pinned tier-1 budget — the final segment is all the bench
+            # flood stage needs to federate)
+            self._feeds.append(feed.attach_sidecar(
+                ObsSidecar(sidecar_path(workdir, wid), wid, node.obs),
+                every=10**9))
             node.boot(skip_self_test=True)
             self.workers.append(node)
         self.user_chain = LocalChain(self.engine, self.user)
@@ -431,9 +479,54 @@ class FleetFloodHarness:
             "lease_counts": dict(sorted(self.leases.counts().items())),
             "commit_dedup": dedup,
             "db_commits": db_commits,
+            "slo": self._slo_report(),
         }
 
+    def _slo_report(self) -> dict:
+        """Byte-deterministic SLO block (docs/fleetscope.md): every
+        latency is CHAIN time — queue wait from the lease table's trace
+        hops (deal → first acquire), time-to-commit from the engine's
+        exact task/solution blocktimes, steal lag from the hop chain's
+        recorded lags — estimated through the centralized fixed-bucket
+        edges (p50/p95/p99). Wall-clock quantities (chip-idle fraction)
+        are deliberately excluded here: they belong to bench/live
+        scrapes, never to a byte-identical report."""
+        import json as _json
+
+        queue_waits: list[int] = []
+        steal_lags: list[int] = []
+        for row in self.leases.rows():
+            hops = _json.loads(row["hops"] or "[]")
+            for h in hops:
+                if h.get("op") in ("acquire", "steal"):
+                    queue_waits.append(int(h["now"])
+                                       - int(row["blocktime"]))
+                    break
+            steal_lags.extend(int(h["lag"]) for h in hops
+                              if "lag" in h)
+        commits = [int(s.blocktime - self.engine.tasks[t].blocktime)
+                   for t, s in self.engine.solutions.items()
+                   if t in self.engine.tasks]
+        report = {
+            "queue_wait_seconds": latency_summary(sorted(queue_waits)),
+            "time_to_commit_seconds": latency_summary(sorted(commits)),
+            "steal_lag_seconds": latency_summary(sorted(steal_lags)),
+            "thresholds": {
+                "queue_wait_p95": self.slo.queue_wait_p95,
+                "time_to_commit_p99": self.slo.time_to_commit_p99,
+                "steal_lag_p99": self.slo.steal_lag_p99,
+            },
+        }
+        report["breaches"] = evaluate_slo(self.slo, report)
+        report["ok"] = not report["breaches"]
+        return report
+
     def close(self) -> None:
+        now = self.engine.now
+        for feed in self._feeds:
+            feed.flush_sidecar(now)
+            if feed._sidecar is not None:
+                feed._sidecar.close()
         for w in self.workers:
             w.close()
         self.leases.close()
@@ -464,4 +557,10 @@ def flood_findings(report: dict):
         if state not in ("done", "invalid", "failed"):
             find(f"{n} lease(s) stuck non-terminal in state {state!r} "
                  "after drain")
+    # the SLO layer (docs/fleetscope.md): a declared objective that the
+    # measured chain-time percentiles breach fails the soak — SLO101,
+    # the acceptance gate the million-task nightly will stand on
+    for breach in (report.get("slo") or {}).get("breaches", ()):
+        out.append(SimFinding(rule="SLO101", message=breach,
+                              scenario="flood", seed=report["seed"]))
     return out

@@ -130,6 +130,15 @@ class SimResult:
     lease_history: list = field(default_factory=list)
     lease_counts: dict = field(default_factory=dict)
     commit_rows: list = field(default_factory=list)
+    # fleetscope sidecar directory (docs/fleetscope.md): one
+    # `<member>.obs.sqlite` per fleet member, flushed at drain —
+    # federation tests read these; empty on single-node runs
+    sidecar_dir: str = ""
+    # events evicted from any fleet worker's journal ring: when > 0,
+    # SIM112 cannot assert adoption COMPLETENESS (a missing lease_hop
+    # may simply have fallen off the ring) and downgrades to its
+    # structural checks
+    journal_dropped: int = 0
 
     def repro(self) -> str:
         return (f"python -m arbius_tpu.sim --scenario "

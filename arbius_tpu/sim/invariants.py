@@ -51,6 +51,18 @@ reproduces it byte-identically.
                                and no reveal without granted commit
                                rights — sim/bugs.py's double-lease
                                node must trip exactly this
+  SIM112  trace completeness   fleet runs only (docs/fleetscope.md):
+                               every task's cross-process span chain is
+                               gap-free and hop-consistent — the lease
+                               table's hop indices are contiguous and
+                               start at the coordinator's deal, every
+                               worker-journaled `lease_hop` adoption
+                               matches a hop the table actually
+                               granted, every acquire/steal hop WAS
+                               adopted in that worker's journal, and no
+                               fleet reveal happened without a hop —
+                               sim/bugs.py's span-gap worker (drops the
+                               adoption events) must trip exactly this
 
 The checkers are deliberately redundant with the engine's own reverts
 (defense in depth): their job is to catch a *node* that violates the
@@ -500,6 +512,102 @@ def check_fleet(result, find) -> None:
                      "the chain")
 
 
+_HOP_OPS = ("deal", "acquire", "steal", "reclaim")
+
+
+def check_trace_chain(result, find) -> None:
+    """SIM112 (fleet runs only, docs/fleetscope.md): cross-process
+    trace completeness. The lease table's `hops` column is the shared
+    truth of every task's deal/acquire/steal/reclaim chain; each
+    worker's `lease_hop` journal events are its local adoption record.
+    A settled task is traceable iff (a) the chain parses, is
+    index-contiguous, and starts at the coordinator's deal, (b) every
+    journaled adoption matches a hop the table granted to that worker,
+    (c) every granted acquire/steal hop was adopted in that worker's
+    journal (the gap the span-gap bug injects), and (d) no fleet
+    reveal landed without the revealer holding a hop."""
+    import json as _json
+
+    workers = getattr(result, "fleet_workers", ())
+    if not workers:
+        return
+    hops_by_task: dict[str, list[dict]] = {}
+    for row in getattr(result, "lease_rows", ()):
+        tid = row["taskid"]
+        try:
+            hops = _json.loads(row.get("hops") or "[]")
+        except ValueError:
+            find("SIM112", tid, "lease hop chain is not valid JSON: "
+                 f"{row.get('hops')!r}")
+            continue
+        hops_by_task[tid] = hops
+        if [h.get("hop") for h in hops] != list(range(len(hops))):
+            find("SIM112", tid,
+                 "hop chain has gaps or reordered indices: "
+                 + str([h.get("hop") for h in hops]))
+        if not hops or hops[0].get("op") != "deal":
+            find("SIM112", tid,
+                 "hop chain does not start at the coordinator's deal: "
+                 f"{hops[:1]}")
+        for h in hops:
+            if h.get("op") not in _HOP_OPS:
+                find("SIM112", tid,
+                     f"unknown hop op {h.get('op')!r} at index "
+                     f"{h.get('hop')}")
+    adopted: dict[tuple, list[str]] = {}
+    for ev in result.journal_events:
+        if ev.get("kind") != "lease_hop":
+            continue
+        adopted.setdefault((ev.get("taskid"), ev.get("hop")),
+                           []).append(ev.get("worker"))
+    for (tid, hop), who in sorted(adopted.items()):
+        hops = hops_by_task.get(tid)
+        h = hops[hop] if hops is not None and isinstance(hop, int) \
+            and 0 <= hop < len(hops) else None
+        if h is None:
+            find("SIM112", tid,
+                 f"worker(s) {sorted(who)} journaled adoption of hop "
+                 f"{hop} the lease table never granted")
+            continue
+        for w in who:
+            if h.get("op") not in ("acquire", "steal") \
+                    or h.get("worker") != w:
+                find("SIM112", tid,
+                     f"hop {hop} adopted by {w} but the lease table "
+                     f"records op={h.get('op')!r} "
+                     f"worker={h.get('worker')!r} — the chain is "
+                     "hop-inconsistent across processes")
+    if getattr(result, "journal_dropped", 0) == 0:
+        # adoption COMPLETENESS is only decidable when no worker's
+        # journal ring evicted events — a missing lease_hop behind a
+        # nonzero dropped count may simply have fallen off the ring,
+        # and a false "gap" here would poison the one checker whose
+        # contract is that span-gap fails it ALONE
+        for tid, hops in sorted(hops_by_task.items()):
+            for h in hops:
+                if h.get("op") in ("acquire", "steal") and \
+                        h.get("worker") not in adopted.get(
+                            (tid, h.get("hop")), []):
+                    find("SIM112", tid,
+                         f"span chain gap: hop {h.get('hop')} "
+                         f"({h.get('op')} by {h.get('worker')}) was "
+                         "never adopted in that worker's journal — "
+                         "the cross-process trace is broken")
+    held = {tid: {h.get("worker") for h in hops
+                  if h.get("op") in ("acquire", "steal")}
+            for tid, hops in hops_by_task.items()}
+    worker_of_addr = {addr: f"worker-{i}"
+                      for i, addr in enumerate(workers)}
+    for addr in workers:
+        for r in _sender_writes(result, "submitSolution", addr):
+            tid = "0x" + r.values[0].hex()
+            if worker_of_addr[addr] not in held.get(tid, ()):
+                find("SIM112", tid,
+                     f"{worker_of_addr[addr]} ({addr}) revealed a "
+                     "solution without ever holding a hop in the "
+                     "task's trace chain")
+
+
 CHECKERS = (
     check_task_conservation,
     check_commit_before_reveal,
@@ -512,6 +620,7 @@ CHECKERS = (
     check_stage_order,
     check_witness,
     check_fleet,
+    check_trace_chain,
 )
 
 

@@ -814,6 +814,23 @@ def _stage_sched_ab(out_path: str) -> None:
                 "misses": reg.counter(
                     "arbius_jit_cache_misses_total").value(),
             },
+            # fleetscope SLO percentiles (docs/fleetscope.md):
+            # fixed-bucket estimates over the FULL histograms (never
+            # window-truncated), so the bench trajectory carries tail
+            # latencies next to sol/h
+            "slo": {
+                "solve_latency_chain_seconds": {
+                    p: node.obs.registry.histogram(
+                        "arbius_solve_latency_chain_seconds"
+                    ).estimate_percentile(q)
+                    for p, q in (("p50", 0.5), ("p95", 0.95),
+                                 ("p99", 0.99))},
+                "stage_infer_seconds": {
+                    p: node._h_stage.estimate_percentile(q,
+                                                         stage="infer")
+                    for p, q in (("p50", 0.5), ("p95", 0.95),
+                                 ("p99", 0.99))},
+            },
             "cids": {"0x" + t.hex(): "0x" + s.cid.hex()
                      for t, s in eng.solutions.items()},
         }
@@ -870,6 +887,72 @@ def _stage_sched_ab(out_path: str) -> None:
                    "result": line}, f, indent=1)
         f.write("\n")
     _note("sched_ab: wrote BENCH_r07.json")
+    hb.stop()
+    os._exit(0)
+
+
+def _stage_flood(out_path: str, tasks: int = 10000,
+                 workers: int = 4) -> None:
+    """flood stage (docs/fleetscope.md): the 10k-lifecycle fleet flood
+    through the in-process engine, reported WITH the SLO percentile
+    block — queue-wait / time-to-commit / steal-lag p50/p95/p99 over
+    chain time (byte-deterministic, same substrate as
+    `simsoak --flood`) plus the wall-clock quantities a bench line may
+    carry (tasks/hour, chip-idle fraction — wall time stays out of the
+    deterministic report and in this line). Writes BENCH_r11.json so
+    the bench trajectory restarts with latency percentiles as
+    first-class numbers, not just sol/h."""
+    import tempfile
+
+    hb = _Heartbeat("flood")
+    from arbius_tpu.sim.fleet import FleetFloodHarness
+
+    hb.set(f"flood: {tasks} tasks / {workers} workers")
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="benchflood-") as tmp:
+        harness = FleetFloodHarness(tasks, workers, tmp)
+        try:
+            report = harness.run()
+            idle = sum(
+                w.obs.registry.counter(
+                    "arbius_chip_idle_seconds_total").value()
+                for w in harness.workers)
+        finally:
+            harness.close()
+    elapsed = time.perf_counter() - t0
+    line = {
+        "metric": "flood_tasks_per_hour",
+        "value": round(3600.0 * report["claimed"] / elapsed, 1),
+        "unit": (f"task lifecycles/hour ({tasks} tasks through a "
+                 f"{workers}-worker fleet over the in-process engine, "
+                 "CPU wall clock — load sanity, no perf claim)"),
+        "vs_baseline": 0.0,
+        "note": ("flood: fleet soak with the fleetscope SLO percentile "
+                 "report embedded — queue-wait/time-to-commit/steal-lag "
+                 "p50/p95/p99 are chain-time and byte-deterministic; "
+                 "tasks/hour and chip-idle are wall-clock "
+                 "(docs/fleetscope.md)"),
+        "stage": "flood",
+        "slo": report["slo"],
+        "claimed": report["claimed"],
+        "rounds": report["rounds"],
+        "commit_dedup": report["commit_dedup"],
+        "max_backlog": report["max_backlog"],
+        "db_commits": report["db_commits"],
+        "chip_idle_seconds": round(idle, 4),
+        # fraction of the fleet's total worker-seconds (N workers run
+        # concurrently, so the denominator is workers × wall) — keeps
+        # the number inside SLOConfig's documented [0, 1] range
+        "chip_idle_fraction": round(
+            idle / max(workers * elapsed, 1e-9), 6),
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+    }
+    _emit(out_path, line)
+    with open(os.path.join(_REPO, "BENCH_r11.json"), "w") as f:
+        json.dump({"ok": True, "stage": "flood", "result": line},
+                  f, indent=1)
+        f.write("\n")
+    _note("flood: wrote BENCH_r11.json")
     hb.stop()
     os._exit(0)
 
@@ -1342,7 +1425,8 @@ def _record_goldens(hb: _Heartbeat, left, only_missing: bool = False) -> None:
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage",
-                    choices=["tiny", "session", "mesh_ab", "sched_ab"])
+                    choices=["tiny", "session", "mesh_ab", "sched_ab",
+                             "flood"])
     ap.add_argument("--out")
     ns = ap.parse_args()
     if ns.stage is not None and not ns.out:
@@ -1355,5 +1439,7 @@ if __name__ == "__main__":
         _stage_mesh_ab(ns.out)
     elif ns.stage == "sched_ab":
         _stage_sched_ab(ns.out)
+    elif ns.stage == "flood":
+        _stage_flood(ns.out)
     else:
         _stage_session(ns.out)

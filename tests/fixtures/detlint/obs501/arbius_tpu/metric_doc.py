@@ -1,0 +1,31 @@
+"""OBS501 fixture: registered metric names vs docs/observability.md."""
+from arbius_tpu.obs import current_obs
+
+
+def report_documented():
+    obs = current_obs()
+    # documented rows: clean
+    obs.registry.counter("arbius_tasks_seen_total").inc()
+    obs.registry.histogram("arbius_stage_seconds",
+                           labelnames=("stage",)).observe(1.0,
+                                                          stage="infer")
+
+
+def report_undocumented():
+    obs = current_obs()
+    # no row in docs/observability.md: OBS501, one per call site
+    obs.registry.counter("arbius_fixture_rotting_total").inc()
+    obs.registry.gauge(name="arbius_fixture_rotting_depth").set(1)
+
+
+def report_waived():
+    obs = current_obs()
+    # detlint: allow[OBS501] fixture: a deliberate throwaway series
+    obs.registry.counter("arbius_fixture_waived_total").inc()
+
+
+def report_family():
+    obs = current_obs()
+    name = "tasks_seen"
+    # family-constructor: non-literal names are out of OBS501's reach
+    obs.registry.counter(f"arbius_{name}_total").inc()

@@ -434,6 +434,58 @@ def test_conc302_enforced_in_pipeline_cannot_be_waived():
     assert not baseline_mod.update(hits, None).entries
 
 
+# -- OBS501: metric-name ↔ doc drift ----------------------------------------
+
+_OBS_PY = "arbius_tpu/obs/somefile.py"   # OBS501 is arbius_tpu/-scoped
+
+
+def test_obs501_undocumented_metric_is_a_finding():
+    src = ('obs.registry.counter("arbius_zz_rotting_total", "x").inc()\n'
+           'obs.registry.counter("arbius_tasks_seen_total").inc()\n')
+    hits = analyze_source(src, _OBS_PY)
+    assert rules_of(hits) == ["OBS501"]
+    assert "arbius_zz_rotting_total" in hits[0].message
+    assert "docs/observability.md" in hits[0].message
+    # outside the shipped tree (tools/tests) metrics are free
+    assert not analyze_source(src, "tools/somefile.py")
+    assert not analyze_source(src, "tests/somefile.py")
+
+
+def test_obs501_skips_family_constructors_and_keywords():
+    # f-string names are families whose members are documented rows;
+    # a name= keyword literal IS checked
+    src = ('reg.counter(f"arbius_{name}_total").inc()\n'
+           'reg.gauge(name="arbius_zz_rotting_depth")\n'
+           'reg.histogram("arbius_stage_seconds")\n')
+    hits = analyze_source(src, _OBS_PY)
+    assert rules_of(hits) == ["OBS501"]
+    assert "arbius_zz_rotting_depth" in hits[0].message
+
+
+def test_obs501_every_new_fleetscope_metric_is_documented():
+    """The rule is live on the real tree: the fleetscope metrics this
+    PR adds must each resolve against the doc (and the whole-package
+    self-check below keeps the invariant for every future metric)."""
+    from arbius_tpu.analysis.rules_obs import documented_metric_names
+
+    documented = documented_metric_names()
+    for name in ("arbius_fleet_queue_wait_seconds",
+                 "arbius_fleet_time_to_commit_seconds",
+                 "arbius_obs_sidecar_flushes_total"):
+        assert name in documented, name
+
+
+def test_obs501_fixture_golden_json():
+    fixroot = FIXDIR / "obs501"
+    got = _json_report([str(fixroot / "arbius_tpu")], str(fixroot))
+    want = (FIXDIR / "obs501.golden.json").read_text()
+    assert got == want
+    doc = json.loads(got)
+    assert [f["rule"] for f in doc["findings"]] == ["OBS501"] * 2
+    # the pragma'd registration in the fixture was absorbed by allow[]
+    assert not any("waived" in f["snippet"] for f in doc["findings"])
+
+
 # -- suppressions, enforce, LINT001 -----------------------------------------
 
 def test_inline_suppression_same_line_and_above():

@@ -1,0 +1,480 @@
+"""fleetscope tier-1 suite: percentile estimation, export/merge/render
+determinism, sidecar persistence, federation (incl. the dead-gauge NaN
+contract), the SLO layer, the coordinator's federated scrape, and the
+fleet-mode CLIs. Everything here is unit-speed — the end-to-end halves
+(SIM112, the flood SLO report) live in tests/test_sim.py.
+"""
+import json
+import pathlib
+import sys
+
+import pytest
+
+from arbius_tpu.node.config import ConfigError, SLOConfig, load_config
+from arbius_tpu.obs import Obs
+from arbius_tpu.obs.fleetscope import (
+    ObsSidecar,
+    evaluate_slo,
+    federate,
+    latency_summary,
+    merge_exports,
+    merge_journals,
+    read_sidecars,
+    sidecar_path,
+    task_timeline,
+)
+from arbius_tpu.obs.registry import (
+    CHAIN_SECONDS_BUCKETS,
+    MetricsRegistry,
+    estimate_percentile,
+    merge_bucket_counts,
+    render_export,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+# -- percentile estimation over fixed buckets --------------------------------
+
+def test_estimate_percentile_interpolates_within_bucket():
+    edges = (1.0, 2.0, 4.0)
+    # 10 samples all landing in the (2, 4] bucket
+    counts = [0, 0, 10, 0]
+    assert estimate_percentile(edges, counts, 0.5) == pytest.approx(3.0)
+    # p0 clamps to the bucket's lower edge, p1 to its upper
+    assert estimate_percentile(edges, counts, 0.0) == pytest.approx(2.0)
+    assert estimate_percentile(edges, counts, 1.0) == pytest.approx(4.0)
+
+
+def test_estimate_percentile_empty_and_open_bucket():
+    edges = (1.0, 2.0)
+    assert estimate_percentile(edges, [0, 0, 0], 0.5) is None
+    # mass in the +Inf bucket clamps to the top finite edge
+    assert estimate_percentile(edges, [0, 0, 5], 0.99) == 2.0
+    with pytest.raises(ValueError, match="\\+Inf"):
+        estimate_percentile(edges, [1, 2], 0.5)
+
+
+def test_histogram_estimate_percentile_not_window_truncated():
+    reg = MetricsRegistry()
+    h = reg.histogram("h", buckets=(1.0, 10.0), recent_window=4)
+    for _ in range(100):
+        h.observe(0.5)
+    for _ in range(100):
+        h.observe(5.0)
+    # the recent window only saw the tail; the bucket estimate sees all
+    assert h.percentile(0.5) == 5.0
+    est = h.estimate_percentile(0.5)
+    assert est is not None and est < 2.0
+    assert h.bucket_counts() == [100, 100, 0]
+
+
+def test_merge_bucket_counts_rejects_mismatched_edges():
+    with pytest.raises(ValueError, match="mismatched bucket edges"):
+        merge_bucket_counts((1.0, 2.0), [1, 0, 0],
+                            (1.0, 3.0), [1, 0, 0])
+    assert merge_bucket_counts((1.0, 2.0), [1, 2, 3],
+                               (1.0, 2.0), [4, 5, 6]) == [5, 7, 9]
+
+
+def test_merging_histogram_exports_with_drifted_edges_fails():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("arbius_x_seconds", buckets=(1.0, 2.0)).observe(1.5)
+    b.histogram("arbius_x_seconds", buckets=(1.0, 4.0)).observe(1.5)
+    with pytest.raises(ValueError, match="mismatched bucket edges"):
+        merge_exports([("a", a.export()), ("b", b.export())])
+
+
+def test_latency_summary_deterministic_ordering():
+    vals = [3, 1, 500, 40, 40, 7]
+    s = latency_summary(vals)
+    assert s == latency_summary(sorted(vals))
+    assert s["count"] == 6 and s["p50"] <= s["p95"] <= s["p99"]
+
+
+# -- export / merge / render -------------------------------------------------
+
+def _registry(order_flip: bool, n: int) -> MetricsRegistry:
+    reg = MetricsRegistry()
+    names = ["arbius_b_total", "arbius_a_total"]
+    if order_flip:
+        names.reverse()
+    for name in names:
+        reg.counter(name, "help text").inc(n)
+    g = reg.gauge("arbius_depth", "d", labelnames=("stage",))
+    g.set(n, stage="encode")
+    h = reg.histogram("arbius_lat_seconds", "l",
+                      buckets=CHAIN_SECONDS_BUCKETS)
+    for v in (1, 30, 600):
+        h.observe(v * n)
+    return reg
+
+
+def test_merge_and_render_byte_identical_in_any_order():
+    a, b = _registry(False, 1), _registry(True, 3)
+    ab = render_export(merge_exports([("a", a.export()),
+                                      ("b", b.export())]))
+    ba = render_export(merge_exports([("b", b.export()),
+                                      ("a", a.export())]))
+    assert ab == ba
+    assert "arbius_a_total 4" in ab and "arbius_b_total 4" in ab
+    assert 'arbius_depth{stage="encode"} 4' in ab
+    # merged histogram: bucket counts summed (6 observations total)
+    assert "arbius_lat_seconds_count 6" in ab
+
+
+def test_render_export_matches_local_render_bytes():
+    reg = _registry(False, 2)
+    assert render_export(reg.export()) == reg.render()
+
+
+def test_shape_conflict_across_members_is_an_error():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("arbius_x_total").inc()
+    b.gauge("arbius_x_total").set(1)
+    with pytest.raises(ValueError, match="different shapes|kind"):
+        merge_exports([("a", a.export()), ("b", b.export())])
+
+
+def test_dead_labeled_gauge_nan_propagates_through_federation():
+    """A labeled callback gauge whose source died in ONE member must
+    surface as `name NaN` in the FEDERATED exposition too — an
+    unreachable lease table must never scrape fleet-wide as 'fully
+    drained' (the PR 9 dead-source contract, lifted to the fleet)."""
+    alive, dead = MetricsRegistry(), MetricsRegistry()
+    alive.gauge("arbius_fleet_leases", labelnames=("state",),
+                fn=lambda: {"pending": 3})
+    def boom():
+        raise RuntimeError("lease table gone")
+    dead.gauge("arbius_fleet_leases", labelnames=("state",), fn=boom)
+    text = render_export(merge_exports([("a", alive.export()),
+                                        ("b", dead.export())]))
+    assert "arbius_fleet_leases NaN" in text
+    # an unlabeled summed gauge propagates NaN arithmetically
+    alive2, dead2 = MetricsRegistry(), MetricsRegistry()
+    alive2.gauge("arbius_queue_depth", fn=lambda: 4)
+    dead2.gauge("arbius_queue_depth", fn=boom)
+    text2 = render_export(merge_exports([("a", alive2.export()),
+                                         ("b", dead2.export())]))
+    assert "arbius_queue_depth NaN" in text2
+
+
+# -- sidecars + federation ---------------------------------------------------
+
+def _member_obs(n: int) -> Obs:
+    obs = Obs(now_fn=lambda: 100 + n)
+    obs.registry.counter("arbius_tasks_seen_total", "seen").inc(n)
+    obs.journal.record("lease_hop", taskid="0xt1", worker=f"worker-{n}",
+                       hop=n, op="acquire")
+    return obs
+
+
+def test_sidecar_roundtrip_and_federation(tmp_path):
+    for i in (1, 2):
+        obs = _member_obs(i)
+        sc = ObsSidecar(sidecar_path(str(tmp_path), f"worker-{i}"),
+                        f"worker-{i}", obs)
+        assert sc.flush(now=100 + i) == 1
+        # idempotent re-flush: same seqs are INSERT OR IGNOREd
+        assert sc.flush(now=100 + i) == 0
+        sc.close()
+    members = read_sidecars(str(tmp_path))
+    assert [m for m, _, _ in members] == ["worker-1", "worker-2"]
+    view = federate(str(tmp_path))
+    assert view["members"] == ["worker-1", "worker-2"]
+    text = render_export(view["export"])
+    assert "arbius_tasks_seen_total 3" in text
+    # sidecar flushes counted (and documented — OBS501)
+    assert "arbius_obs_sidecar_flushes_total" in text
+    # merged timeline: ordered by (chain, member, seq), member-tagged
+    tl = task_timeline(view["events"], "0xt1")
+    assert [e["member"] for e in tl] == ["worker-1", "worker-2"]
+    assert [e["chain"] for e in tl] == [101, 102]
+
+
+def test_sidecar_journal_retention_bounds_the_file(tmp_path):
+    """The sidecar is a flight recorder, not an archive: journal rows
+    beyond `journal_retention` are pruned at flush, so a long-running
+    member's .obs.sqlite stays bounded."""
+    import sqlite3
+
+    obs = Obs()
+    sc = ObsSidecar(sidecar_path(str(tmp_path), "w"), "w", obs,
+                    journal_retention=5)
+    for i in range(12):
+        obs.journal.record("tickmark", i=i)
+        if i % 4 == 3:
+            sc.flush(now=i)
+    sc.close()
+    conn = sqlite3.connect(sidecar_path(str(tmp_path), "w"))
+    seqs = [r[0] for r in conn.execute(
+        "SELECT seq FROM journal ORDER BY seq")]
+    conn.close()
+    assert len(seqs) == 5 and seqs == list(range(8, 13))
+
+
+def test_sidecar_restart_clears_dead_lifes_journal(tmp_path):
+    """A restarted production member reuses its sidecar path with a
+    FRESH journal whose seqs restart at 1: the dead life's rows (whose
+    seqs ran ahead) must be cleared at open, or INSERT OR IGNORE would
+    freeze the sidecar at the old life's events forever."""
+    path = sidecar_path(str(tmp_path), "w")
+    life1 = Obs()
+    for i in range(5):
+        life1.journal.record("old_life", i=i)
+    sc = ObsSidecar(path, "w", life1)
+    sc.flush(now=10)
+    sc.close()
+    life2 = Obs()
+    life2.journal.record("new_life")
+    sc = ObsSidecar(path, "w", life2)
+    sc.flush(now=20)
+    sc.close()
+    _, _, events = read_sidecars(str(tmp_path))[0]
+    assert [e["kind"] for e in events] == ["new_life"]
+
+
+def test_merge_rejects_drifted_edges_on_disjoint_label_series():
+    """A member contributing only NEW label series must not smuggle a
+    drifted edge set past the per-series merge — edge compatibility is
+    per metric."""
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("arbius_x_seconds", buckets=(1.0, 2.0),
+                labelnames=("stage",)).observe(1.5, stage="infer")
+    b.histogram("arbius_x_seconds", buckets=(1.0, 4.0),
+                labelnames=("stage",)).observe(1.5, stage="decode")
+    with pytest.raises(ValueError, match="mismatched bucket edges"):
+        merge_exports([("a", a.export()), ("b", b.export())])
+
+
+def test_merge_journals_orders_by_chain_time():
+    a = [{"kind": "x", "seq": 1, "chain": 50}]
+    b = [{"kind": "y", "seq": 1, "chain": 10},
+         {"kind": "z", "seq": 2, "chain": 50}]
+    merged = merge_journals([("b", b), ("a", a)])
+    assert [(e["member"], e["kind"]) for e in merged] == \
+        [("b", "y"), ("a", "x"), ("b", "z")]
+
+
+def test_fleet_metrics_server_serves_federated_view(tmp_path):
+    import urllib.request
+
+    from arbius_tpu.obs.fleetscope import FleetMetricsServer
+
+    obs = _member_obs(5)
+    sc = ObsSidecar(sidecar_path(str(tmp_path), "worker-5"),
+                    "worker-5", obs)
+    sc.flush(now=105)
+    sc.close()
+    coord = Obs()
+    coord.registry.counter("arbius_fleet_tasks_total", "dealt").inc(7)
+    # the coordinator ALSO flushes its own sidecar into the same dir
+    # (the production wiring): the live registry must supersede that
+    # stale snapshot, never sum with it
+    csc = ObsSidecar(sidecar_path(str(tmp_path), "coordinator"),
+                     "coordinator", coord)
+    csc.flush(now=100)
+    csc.close()
+    coord.registry.counter("arbius_fleet_tasks_total").inc(2)  # now 9
+    server = FleetMetricsServer(str(tmp_path), coord)
+    server.start()
+    try:
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            body = r.read().decode()
+            assert "version=0.0.4" in r.headers["Content-Type"]
+        assert "arbius_tasks_seen_total 5" in body
+        # live 9, NOT live+sidecar 16 (and not the stale 7)
+        assert "arbius_fleet_tasks_total 9" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/nope", timeout=10)
+    finally:
+        server.stop()
+
+
+# -- SLO config + evaluation -------------------------------------------------
+
+def test_slo_config_validation():
+    with pytest.raises(ConfigError, match="queue_wait_p95"):
+        SLOConfig(queue_wait_p95=-1)
+    with pytest.raises(ConfigError, match="chip_idle_fraction"):
+        SLOConfig(chip_idle_fraction=1.5)
+    cfg = load_config(json.dumps({"slo": {"time_to_commit_p99": 120}}))
+    assert cfg.slo.time_to_commit_p99 == 120
+    with pytest.raises(ConfigError, match="slo"):
+        load_config(json.dumps({"slo": {"bogus": 1}}))
+    with pytest.raises(ConfigError, match="sidecar_flush_every"):
+        load_config(json.dumps({"fleet": {"sidecar_flush_every": 0}}))
+
+
+def test_evaluate_slo_breaches_and_holds():
+    report = {
+        "queue_wait_seconds": {"count": 10, "p50": 1, "p95": 9,
+                               "p99": 20},
+        "time_to_commit_seconds": {"count": 10, "p50": 5, "p95": 50,
+                                   "p99": 90},
+        "steal_lag_seconds": {"count": 0, "p50": None, "p95": None,
+                              "p99": None},
+        "chip_idle_fraction": 0.4,
+    }
+    assert evaluate_slo(SLOConfig(), report) == []
+    breaches = evaluate_slo(
+        SLOConfig(queue_wait_p95=5, time_to_commit_p99=100,
+                  steal_lag_p99=1, chip_idle_fraction=0.3), report)
+    assert len(breaches) == 2
+    assert any("queue_wait_seconds p95" in b for b in breaches)
+    assert any("chip_idle_fraction" in b for b in breaches)
+    # empty percentiles (no traffic) never breach — liveness is SIM108
+    assert not evaluate_slo(SLOConfig(steal_lag_p99=0.1), report)
+
+
+# -- the fleet-mode CLIs -----------------------------------------------------
+
+@pytest.fixture()
+def sidecar_dir(tmp_path):
+    for i in (1, 2):
+        obs = _member_obs(i)
+        obs.registry.histogram(
+            "arbius_fleet_queue_wait_seconds", "qw",
+            buckets=CHAIN_SECONDS_BUCKETS).observe(4 * i, tag="0xt1")
+        sc = ObsSidecar(sidecar_path(str(tmp_path), f"worker-{i}"),
+                        f"worker-{i}", obs)
+        sc.flush(now=100 + i)
+        sc.close()
+    return tmp_path
+
+
+def test_fleetscope_cli_prom_and_slo(sidecar_dir, capsys):
+    from fleetscope import main as fs_main
+
+    assert fs_main([str(sidecar_dir), "prom"]) == 0
+    out = capsys.readouterr().out
+    assert "arbius_tasks_seen_total 3" in out
+    assert "arbius_fleet_queue_wait_seconds_count 2" in out
+    # slo: clean without thresholds, exit 1 on a declared breach
+    assert fs_main([str(sidecar_dir), "slo"]) == 0
+    capsys.readouterr()
+    assert fs_main([str(sidecar_dir), "slo",
+                    "--queue-wait-p95", "0.5"]) == 1
+    out = capsys.readouterr().out
+    assert "SLO101" in out and "queue_wait_seconds p95" in out
+
+
+def test_fleetscope_cli_timeline(sidecar_dir, capsys):
+    from fleetscope import main as fs_main
+
+    assert fs_main([str(sidecar_dir), "timeline",
+                    "--taskid", "0xt1"]) == 0
+    out = capsys.readouterr().out
+    assert "worker-1" in out and "worker-2" in out
+    assert "lease_hop" in out
+    # --limit 0 means "no events", not "all of them" ([-0:] trap)
+    assert fs_main([str(sidecar_dir), "timeline", "--limit", "0"]) == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_corrupt_sidecar_is_a_usage_error_not_a_traceback(tmp_path,
+                                                          capsys):
+    """A member killed mid-creation leaves a garbage .obs.sqlite: the
+    reader raises ValueError naming the file (which the CLIs turn into
+    exit 2) and the federated metrics server answers a diagnosable 500
+    — one bad member must never crash the whole-fleet view."""
+    import urllib.request
+
+    from fleetscope import main as fs_main
+
+    from arbius_tpu.obs.fleetscope import FleetMetricsServer
+
+    bad = tmp_path / ("worker-9" + ".obs.sqlite")
+    bad.write_bytes(b"not a sqlite file at all")
+    with pytest.raises(ValueError, match="unreadable obs sidecar"):
+        read_sidecars(str(tmp_path))
+    assert fs_main([str(tmp_path), "prom"]) == 2
+    assert "unreadable obs sidecar" in capsys.readouterr().err
+    server = FleetMetricsServer(str(tmp_path))
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=10)
+        assert ei.value.code == 500
+        assert b"unreadable obs sidecar" in ei.value.read()
+    finally:
+        server.stop()
+
+
+def test_obs_dump_fleet_mode(sidecar_dir, capsys):
+    from obs_dump import main as od_main
+
+    assert od_main(["--fleet", str(sidecar_dir), "prom"]) == 0
+    assert "arbius_tasks_seen_total 3" in capsys.readouterr().out
+    assert od_main(["--fleet", str(sidecar_dir), "journal"]) == 0
+    out = capsys.readouterr().out
+    assert "lease_hop" in out and "worker-2" in out
+    assert od_main(["--fleet", str(sidecar_dir), "trace", "0xt1"]) == 0
+    assert od_main(["--fleet", str(sidecar_dir), "trace", "0xnope"]) == 1
+
+
+# -- lease-table hop chain (the shared-truth half of SIM112) -----------------
+
+def test_lease_hops_record_deal_acquire_steal_reclaim(tmp_path):
+    from arbius_tpu.fleet import LeaseTable
+    from arbius_tpu.obs import use_obs
+
+    obs = Obs()
+    table = LeaseTable(str(tmp_path / "leases.sqlite"))
+    with use_obs(obs):
+        table.add_task("0xt", "0xm", 5, 100, 100)
+        grants = table.acquire("worker-0", now=110, ttl=10, limit=5)
+        assert [g.hop for g in grants] == [1]
+        # worker-0 goes dark; worker-1 steals past the TTL
+        stolen = table.acquire("worker-1", now=130, ttl=10, limit=5)
+        assert stolen[0].stolen and stolen[0].hop == 2
+        table.reclaim(now=150, max_attempts=4)
+    # steal lag observed on BOTH takeover paths (the slo.steal_lag_p99
+    # corpus): worker steal at 130 (lag 10) + coordinator reclaim at
+    # 150 (lag 10)
+    lag_h = obs.registry.get("arbius_fleet_steal_lag_seconds")
+    assert lag_h.count() == 2 and [v for _, v in lag_h.recent()] == \
+        [10, 10]
+    row = dict(table.rows()[0])
+    hops = json.loads(row["hops"])
+    assert [h["hop"] for h in hops] == [0, 1, 2, 3]
+    assert [h["op"] for h in hops] == ["deal", "acquire", "steal",
+                                      "reclaim"]
+    assert hops[2]["worker"] == "worker-1" and hops[2]["lag"] == 10
+    assert hops[3]["lag"] == 10
+    # queue wait observed on the FIRST acquire only, chain buckets
+    h = obs.registry.get("arbius_fleet_queue_wait_seconds")
+    assert h.count() == 1 and h.recent() == [("0xt", 10)]
+    assert h.buckets == tuple(CHAIN_SECONDS_BUCKETS)
+    table.close()
+
+
+def test_lease_hops_migration_adds_column(tmp_path):
+    """A pre-fleetscope lease db (no hops column) opens and migrates in
+    place — the shared file may outlive any one member's version."""
+    import sqlite3
+
+    from arbius_tpu.fleet import LeaseTable
+
+    path = str(tmp_path / "old.sqlite")
+    conn = sqlite3.connect(path)
+    conn.execute(
+        "CREATE TABLE leases (id INTEGER PRIMARY KEY AUTOINCREMENT,"
+        " taskid TEXT UNIQUE, model TEXT, fee TEXT, blocktime INT,"
+        " state TEXT, worker TEXT DEFAULT '', expires INT DEFAULT 0,"
+        " acquired INT DEFAULT 0, attempts INT DEFAULT 0,"
+        " steals INT DEFAULT 0)")
+    conn.execute("INSERT INTO leases (taskid, model, fee, blocktime,"
+                 " state) VALUES ('0xold', '0xm', '1', 50, 'pending')")
+    conn.commit()
+    conn.close()
+    table = LeaseTable(path)
+    grants = table.acquire("worker-0", now=60, ttl=10, limit=5)
+    assert grants[0].taskid == "0xold" and grants[0].hop == 0
+    hops = json.loads(dict(table.rows()[0])["hops"])
+    assert [h["op"] for h in hops] == ["acquire"]
+    table.close()

@@ -396,6 +396,60 @@ def test_fleet_of_one_matches_bare_node_byte_for_byte(tmp_path):
         {s.validator for s in rb.engine.solutions.values()}
 
 
+def test_fleet_matrix_passes_sim112_trace_chains(fleet_matrix):
+    """Every fleet matrix run already asserts zero findings — SIM112
+    included. Here: pin that the trace substrate is non-degenerate on
+    a run with steals (fleet-partition): every lease carries a
+    deal-rooted hop chain, and the sidecars federate into a timeline
+    whose lease_hop adoptions cover every acquire/steal hop."""
+    result, _ = fleet_matrix[("fleet-partition", SEEDS[0])]
+    assert result.sidecar_dir
+    hops_seen = 0
+    for row in result.lease_rows:
+        hops = json.loads(row["hops"])
+        assert hops[0]["op"] == "deal"
+        assert [h["hop"] for h in hops] == list(range(len(hops)))
+        hops_seen += len(hops)
+    assert hops_seen > len(result.lease_rows)  # acquires happened
+    assert any(h["op"] == "steal"
+               for row in result.lease_rows
+               for h in json.loads(row["hops"]))
+    from arbius_tpu.obs.fleetscope import federate, render_export
+
+    view = federate(result.sidecar_dir)
+    assert "coordinator" in view["members"] and \
+        "worker-0" in view["members"]
+    text = render_export(view["export"])
+    assert "arbius_fleet_tasks_total" in text
+    assert "arbius_fleet_queue_wait_seconds_count" in text
+    adoptions = [e for e in view["events"]
+                 if e.get("kind") == "lease_hop"]
+    granted = sum(1 for row in result.lease_rows
+                  for h in json.loads(row["hops"])
+                  if h["op"] in ("acquire", "steal"))
+    assert len(adoptions) == granted > 0
+
+
+def test_injected_span_gap_fails_sim112_only(tmp_path):
+    """sim/bugs.py span-gap: a worker whose obs drops the lease_hop
+    adoption events MUST be caught by SIM112's trace-completeness
+    audit — and by nothing else (work still flows, CIDs still land)."""
+    from arbius_tpu.sim.bugs import SpanGapWorkerNode
+    from arbius_tpu.sim.fleet import run_fleet_scenario
+
+    result = run_fleet_scenario(get_scenario("fleet-race"), 0,
+                                workdir=str(tmp_path),
+                                node_cls=SpanGapWorkerNode)
+    findings = check_all(result)
+    sim112 = [f for f in findings if f.rule == "SIM112"]
+    assert sim112, "the span gap went uncaught"
+    assert "never adopted" in sim112[0].message
+    assert not [f for f in findings if f.rule != "SIM112"], \
+        "the injected trace gap bled into other invariants"
+    # the gap is observability-only: every task still claimed
+    assert all(s.claimed for s in result.engine.solutions.values())
+
+
 def test_injected_double_lease_fails_closed(tmp_path):
     """sim/bugs.py double-lease: a worker that ignores the lease
     plane's commit exclusivity MUST be caught by SIM111's cross-worker
@@ -451,6 +505,39 @@ def test_flood_10k_bounded_queues_and_no_lost_tasks(tmp_path, capsys):
     # the flood actually queued deep in the lease plane (the durable
     # overflow buffer did its job)
     assert flood["max_pending_leases"] > bound
+    # the SLO report (docs/fleetscope.md): fleet-wide p50/p95/p99 over
+    # the full 10k corpus, chain time only, no objectives declared →
+    # percentiles present, nothing breached
+    slo = flood["slo"]
+    assert slo["ok"] and slo["breaches"] == []
+    for block in ("queue_wait_seconds", "time_to_commit_seconds"):
+        b = slo[block]
+        assert b["count"] == 10000
+        assert 0 < b["p50"] <= b["p95"] <= b["p99"]
+
+
+def test_flood_slo_breach_fails_closed(tmp_path, capsys):
+    """An injected breach — a declared objective the measured corpus
+    cannot meet — must fail the soak with SLO101 (exit 1)."""
+    rc = sim_main(["--flood", "300", "--workers", "3",
+                   "--slo", "time_to_commit_p99=0.5",
+                   "--workdir", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "SLO101" in captured.out
+    assert "time_to_commit_seconds p99" in captured.out
+
+
+def test_flood_slo_cli_usage_error():
+    assert sim_main(["--flood", "5", "--slo", "bogus=1"]) == 2
+    # a valid SLOConfig field the deterministic flood report cannot
+    # evaluate (wall clock) is rejected, not silently never-checked
+    assert sim_main(["--flood", "5",
+                     "--slo", "chip_idle_fraction=0.2"]) == 2
+    # --slo without --flood: a declared objective that would never be
+    # evaluated must be a usage error, not a silent no-op
+    assert sim_main(["--scenario", "clean",
+                     "--slo", "time_to_commit_p99=1"]) == 2
 
 
 def test_flood_report_deterministic(tmp_path):

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""fleetscope — federate a fleet's obs sidecars from a terminal.
+
+Every fleet member persists registry snapshots + journal segments to
+its own `<member>.obs.sqlite` sidecar under `fleet.sidecar_dir`
+(docs/fleetscope.md). This tool merges them offline — no fleet member
+is contacted:
+
+    python tools/fleetscope.py <dir> prom              # merged exposition
+    python tools/fleetscope.py <dir> timeline          # fleet timeline
+    python tools/fleetscope.py <dir> timeline --taskid 0x…   # one task
+    python tools/fleetscope.py <dir> slo [--queue-wait-p95 S]
+        [--time-to-commit-p99 S] [--steal-lag-p99 S]
+
+`prom` renders the same byte format a node's GET /metrics uses; the
+merge is deterministic (members sort by name — filesystem order never
+reaches the output). `slo` estimates p50/p95/p99 from the federated
+fixed-bucket histograms and exits 1 when a declared threshold is
+breached (the same SLO layer `simsoak --flood` fails closed on).
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from _common import EXIT_CLEAN, EXIT_FINDINGS, EXIT_USAGE, make_parser
+
+# the federated histograms the SLO command reads (docs/fleetscope.md)
+_SLO_METRICS = (
+    ("queue_wait_seconds", "arbius_fleet_queue_wait_seconds"),
+    ("time_to_commit_seconds", "arbius_fleet_time_to_commit_seconds"),
+    ("steal_lag_seconds", "arbius_fleet_steal_lag_seconds"),
+)
+
+
+def _event_line(e: dict) -> str:
+    core = {k: v for k, v in e.items()
+            if k not in ("kind", "seq", "wall", "chain", "member")}
+    chain = f" chain={e['chain']}" if "chain" in e else ""
+    return (f"{e.get('member', '?'):<14} #{e.get('seq', '?'):>6} "
+            f"{e.get('kind', '?'):<16}{chain} "
+            + json.dumps(core, sort_keys=True, default=str))
+
+
+def render_timeline(events: list[dict]) -> str:
+    return "\n".join(_event_line(e) for e in events)
+
+
+def slo_report(view: dict, slo) -> dict:
+    """Percentile report from the federated export + the evaluation
+    against `slo` (node.config.SLOConfig) — shared with render/tests."""
+    from arbius_tpu.obs.fleetscope import (
+        evaluate_slo,
+        summarize_histogram_export,
+    )
+
+    metrics = view["export"].get("metrics", {})
+    report = {}
+    for block, metric in _SLO_METRICS:
+        m = metrics.get(metric)
+        report[block] = summarize_histogram_export(m) if m else \
+            {"count": 0, "p50": None, "p95": None, "p99": None}
+    report["breaches"] = evaluate_slo(slo, report)
+    report["ok"] = not report["breaches"]
+    return report
+
+
+def main(argv=None) -> int:
+    p = make_parser("fleetscope", __doc__)
+    p.add_argument("dir", help="fleet.sidecar_dir to federate")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("prom", help="merged Prometheus exposition")
+    sp = sub.add_parser("timeline",
+                        help="chain-time-ordered fleet journal")
+    sp.add_argument("--taskid", default=None,
+                    help="restrict to one task's cross-process lifecycle")
+    sp.add_argument("--limit", type=int, default=500)
+    sp = sub.add_parser("slo", help="federated SLO percentiles + verdict")
+    sp.add_argument("--queue-wait-p95", type=float, default=None)
+    sp.add_argument("--time-to-commit-p99", type=float, default=None)
+    sp.add_argument("--steal-lag-p99", type=float, default=None)
+    sp.add_argument("--json", action="store_true")
+    ns = p.parse_args(argv)
+
+    from arbius_tpu.obs.fleetscope import (
+        federate,
+        render_export,
+        task_timeline,
+    )
+
+    try:
+        view = federate(ns.dir)
+    except (OSError, ValueError) as e:
+        print(f"fleetscope: {e}", file=sys.stderr)
+        return EXIT_USAGE
+
+    if ns.cmd == "prom":
+        print(render_export(view["export"]), end="")
+        return EXIT_CLEAN
+    if ns.cmd == "timeline":
+        events = view["events"]
+        if ns.taskid:
+            events = task_timeline(events, ns.taskid)
+        # explicit: limit<=0 means "no events", not "all of them"
+        # (events[-0:] would slice the whole list)
+        print(render_timeline(events[-ns.limit:] if ns.limit > 0
+                              else []))
+        print(f"-- {len(events)} event(s) across "
+              f"{len(view['members'])} member(s): "
+              f"{', '.join(view['members'])}", file=sys.stderr)
+        return EXIT_CLEAN
+    # slo
+    from arbius_tpu.node.config import ConfigError, SLOConfig
+
+    try:
+        slo = SLOConfig(queue_wait_p95=ns.queue_wait_p95,
+                        time_to_commit_p99=ns.time_to_commit_p99,
+                        steal_lag_p99=ns.steal_lag_p99)
+    except ConfigError as e:
+        print(f"fleetscope: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    report = slo_report(view, slo)
+    if ns.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for block, _ in _SLO_METRICS:
+            b = report[block]
+            print(f"{block:26s} count={b['count']:<8d} p50={b['p50']} "
+                  f"p95={b['p95']} p99={b['p99']}")
+        for breach in report["breaches"]:
+            print(f"SLO101 {breach}")
+        print("slo: " + ("ok" if report["ok"] else
+                         f"{len(report['breaches'])} breach(es)"))
+    return EXIT_CLEAN if report["ok"] else EXIT_FINDINGS
+
+
+if __name__ == "__main__":
+    sys.exit(main())

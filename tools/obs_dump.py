@@ -12,6 +12,12 @@ RPC port (docs/observability.md) and renders it for a terminal:
 Target selection: --url http://127.0.0.1:<rpc_port> (default port 8080,
 matching MiningConfig.example.json's rpc_port). The render functions
 are pure (tests drive them against an in-process ControlRPC).
+
+Fleet mode (docs/fleetscope.md): `--fleet <sidecar_dir>` reads the
+fleet members' obs SIDECARS instead of a live node — `journal` and
+`trace` merge every member's segments into one chain-time-ordered
+timeline (each line prefixed with its member), `prom` renders the
+federated exposition. Shares the merge code with tools/fleetscope.py.
 """
 from __future__ import annotations
 
@@ -71,10 +77,60 @@ def render_trace(roots: list[dict], indent: int = 0) -> str:
     return "\n".join(out)
 
 
+def _fleet_main(ns) -> int:
+    """--fleet: the same subcommands over merged sidecars (shared merge
+    code: arbius_tpu.obs.fleetscope; docs/fleetscope.md)."""
+    from fleetscope import render_timeline
+
+    from arbius_tpu.obs.fleetscope import (
+        federate,
+        render_export,
+        task_timeline,
+    )
+
+    try:
+        view = federate(ns.fleet)
+    except (OSError, ValueError) as e:
+        print(f"obs_dump: {e}", file=sys.stderr)
+        return 2
+    if ns.cmd == "metrics":
+        print("obs_dump: --fleet has no JSON metrics view — use "
+              "`prom` (federated exposition) or tools/fleetscope.py",
+              file=sys.stderr)
+        return 2
+    if ns.cmd == "prom":
+        print(render_export(view["export"]), end="")
+        return 0
+    events = view["events"]
+    if ns.cmd == "journal":
+        if ns.kind:
+            events = [e for e in events if e.get("kind") == ns.kind]
+        # explicit: limit<=0 means "no events", not "all of them"
+        # (events[-0:] would slice the whole list)
+        print(render_timeline(events[-ns.limit:] if ns.limit > 0
+                              else []))
+        print(f"-- {len(events)} event(s) across "
+              f"{len(view['members'])} member(s)", file=sys.stderr)
+        return 0
+    # trace: the cross-process timeline for one task (span ids are
+    # per-process, so the fleet view is the ordered event chain, not
+    # one tree)
+    timeline = task_timeline(events, ns.taskid)
+    if not timeline:
+        print(f"no events recorded for {ns.taskid} across "
+              f"{len(view['members'])} sidecar(s)", file=sys.stderr)
+        return 1
+    print(render_timeline(timeline))
+    return 0
+
+
 def main(argv=None) -> int:
     p = make_parser("obs_dump", __doc__)
     p.add_argument("--url", default="http://127.0.0.1:8080",
                    help="node control-RPC base URL")
+    p.add_argument("--fleet", default=None, metavar="DIR",
+                   help="read fleet obs sidecars under DIR instead of "
+                        "a live node (docs/fleetscope.md)")
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("metrics", help="JSON metrics view (/api/metrics)")
     sub.add_parser("prom", help="Prometheus exposition (/metrics)")
@@ -85,6 +141,8 @@ def main(argv=None) -> int:
     sp = sub.add_parser("trace", help="span tree for a task (/debug/trace)")
     sp.add_argument("taskid")
     ns = p.parse_args(argv)
+    if ns.fleet is not None:
+        return _fleet_main(ns)
     base = ns.url.rstrip("/")
 
     if ns.cmd == "metrics":
