@@ -1,0 +1,347 @@
+"""arbius_tpu.aotcache — fleet-wide AOT-serialized executable cache.
+
+The compile storm is the cold-boot killer (docs/compile-cache.md): every
+fleet worker used to re-trace AND re-compile every (family, bucket,
+layout) executable at boot, and `arbius_compile_seconds` (PR 7) meters
+exactly how much chip time that burns. This package persists compiled
+executables across lives via JAX's AOT path — `jit(f).lower(*args)
+.compile()` serialized with `jax.experimental.serialize_executable` —
+into a content-addressed on-disk cache whose key is the **graphlint
+canonical program fingerprint** plus the environment signature
+(jaxlib/platform/device kind/count) and the argument/sharding/donation
+signature (aotcache/store.py).
+
+Because the key IS the program identity the goldens already pin,
+invalidation is by construction: a drifted program (a changed sampler
+table, a different accumulation dtype, a new mesh layout) hashes to a
+different key and simply MISSES to a fresh trace+compile. There is no
+version file to forget to bump and no way to load a stale executable.
+
+The cache threads under the one existing executable-cache seam,
+`obs.jit_cache_get` (the model pipelines' `_buckets`, the meshsolve
+probes' `_fns`), as a second tier:
+
+    memory (this life's dict)  →  disk (mmap + deserialize)  →
+    trace + compile (and write back, atomic tmp+rename)
+
+A corrupted, truncated, or wrong-environment entry falls back to
+compile with a journaled `aot_cache_reject` event — never an error and
+never a wrong answer. Determinism: a disk-hit dispatch is the SAME XLA
+program the fresh compile would build (same fingerprint ⇒ same
+canonical jaxpr ⇒ XLA's deterministic lowering), so CIDs are
+byte-identical cache-on vs cache-off (tests/test_aotcache.py pins it
+for image- and video-shaped probes and a real tiny SD-1.5, mesh-off
+and dp2).
+
+Metrics (docs/observability.md): `arbius_jit_cache_hits_total{tier}`
+splits memory vs disk hits; `arbius_aot_cache_{loads,writes,rejects,
+evictions}_total` and `arbius_aot_load_seconds` cover the disk tier.
+All ambient-obs no-ops, like every obs helper.
+"""
+from __future__ import annotations
+
+import pickle
+
+from arbius_tpu.aotcache.store import (
+    CacheReject,
+    args_signature,
+    derive_key,
+    entry_path,
+    env_signature,
+    evict_lru,
+    make_header,
+    read_entry,
+    read_header,
+    scan,
+    total_bytes,
+    touch,
+    write_entry,
+)
+
+_LOADS_HELP = ("AOT cache entries deserialized into live executables "
+               "(disk-tier hits that skipped an XLA compile)")
+_WRITES_HELP = ("Freshly compiled executables serialized into the AOT "
+                "cache (atomic tmp+rename publishes)")
+_REJECTS_HELP = ("AOT cache entries refused at load time (corrupt/"
+                 "truncated/mismatched) — each also journals an "
+                 "aot_cache_reject event; the dispatch falls back to a "
+                 "fresh compile")
+_EVICT_HELP = ("AOT cache entries deleted by LRU eviction under "
+               "aot_cache.max_bytes")
+_SKIPS_HELP = ("AOT cache interactions skipped without publishing — "
+               "the journaled aot_cache_skip reason says which: the "
+               "write-time load-back self-check failed (e.g. XLA-"
+               "persistent-cache-served CPU executables re-serialize "
+               "without their jitted symbols), the publish write "
+               "failed (full/read-only shared dir), or key derivation "
+               "failed (the lookup degraded to the lazy pre-AOT path) "
+               "— never a failed solve (docs/compile-cache.md)")
+_LOAD_SECONDS_HELP = ("Wall seconds to mmap + deserialize one AOT cache "
+                      "entry into a live executable (tagged per "
+                      "executable cache key) — the disk-tier cost that "
+                      "replaces arbius_compile_seconds on a warm boot")
+
+
+class AotCache:
+    """One on-disk executable cache (usually one shared directory per
+    fleet). Installed on a node's `Obs` (`obs.aot_cache`) so
+    `jit_cache_get` finds it ambiently; safe to share across processes
+    — every write is atomic and every read is digest-checked."""
+
+    def __init__(self, cache_dir: str, *, max_bytes: int = 0,
+                 layout: str = "single"):
+        if not cache_dir:
+            raise ValueError("AotCache needs a directory path")
+        self.dir = cache_dir
+        self.max_bytes = int(max_bytes)
+        # the writer's mesh-layout tag (docs/multichip.md mesh_tag; the
+        # node sets it at boot): stamped into every published header and
+        # filtered on by tags(), so workers with DIFFERENT layouts can
+        # share one directory without mis-counting each other's entries
+        # as disk-warm — the cache KEY already separates their programs
+        self.layout = layout
+        self._env = None  # derived once, first use (jax must be up)
+
+    # -- key -------------------------------------------------------------
+    def env(self) -> dict:
+        if self._env is None:
+            self._env = env_signature()
+        return self._env
+
+    def _identity(self, jfn, args, donate_sig: str = ""
+                  ) -> tuple[str, str, str]:
+        """(key, program fingerprint, arg signature) from ONE trace.
+        The fingerprint is graphlint's canonicalization over
+        `jax.make_jaxpr`, which wraps the jitted callable in a pjit eqn
+        — so jit-level in/out_shardings are part of the identity, the
+        same way the per-layout goldens pin them."""
+        import jax
+
+        from arbius_tpu.analysis.graph.fingerprint import fingerprint
+
+        fp = fingerprint(jax.make_jaxpr(jfn)(*args))
+        arg_sig = args_signature(args)
+        return (derive_key(fp, self.env(), arg_sig, donate_sig),
+                fp, arg_sig)
+
+    def key_for(self, jfn, args, *, donate_sig: str = "") -> str:
+        """Trace `jfn` over `args` (no compile) and derive the content
+        address."""
+        return self._identity(jfn, args, donate_sig)[0]
+
+    # -- tiers -----------------------------------------------------------
+    def get_or_compile(self, build, args_thunk, *, tag: str | None = None,
+                       donate_sig: str = ""):
+        """The disk tier behind `obs.jit_cache_get`: build the jitted
+        callable, trace it for its key, then load-or-compile. Returns
+        `(fn, state)` with state ∈ {"disk", "compiled", "fallback"}:
+        "disk"/"compiled" hand back an ALREADY-built executable (AOT —
+        the first dispatch pays no build; the compile/load cost was
+        timed into `arbius_compile_seconds` / `arbius_aot_load_seconds`
+        here), "fallback" hands back the lazy jitted callable untouched
+        because key derivation failed — the cache must never be the
+        reason a solve fails, so a trace error degrades to the exact
+        pre-AOT behavior (journaled `aot_cache_skip`). A compile error
+        propagates: the lazy path would have raised it at dispatch too.
+        A store failure (full/read-only shared dir, unserializable
+        executable) is absorbed by `store` — the solve proceeds on the
+        freshly compiled executable either way."""
+        from arbius_tpu.obs import compile_timer, current_obs
+
+        jfn = build()
+        try:
+            args = tuple(args_thunk())
+            key, fp, arg_sig = self._identity(jfn, args, donate_sig)
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            obs = current_obs()
+            if obs is not None:
+                obs.registry.counter("arbius_aot_cache_skips_total",
+                                     _SKIPS_HELP).inc()
+                obs.event("aot_cache_skip", key=None, tag=tag,
+                          reason=f"key_derivation: {type(e).__name__}: "
+                                 f"{str(e)[:120]}")
+            return jfn, "fallback"
+        fn = self.load(key, tag=tag)
+        if fn is not None:
+            return fn, "disk"
+        with compile_timer(tag):
+            compiled = jfn.lower(*args).compile()
+        self.store(key, compiled, program=fp, arg_sig=arg_sig, tag=tag,
+                   donate_sig=donate_sig)
+        return compiled, "compiled"
+
+    def load(self, key: str, *, tag: str | None = None):
+        """Deserialize one entry into a live executable, or None on a
+        miss OR a reject (journaled — the caller compiles either way).
+        The header's key and environment are re-checked against this
+        process even though both are baked into the filename: a copied
+        or renamed file must reject, not load."""
+        import os
+
+        from arbius_tpu.obs import current_obs
+
+        path = entry_path(self.dir, key)
+        if not os.path.exists(path):
+            return None
+        obs = current_obs()
+        import time
+
+        # detlint: allow[DET101] obs load timing; never reaches solve bytes
+        t0 = time.perf_counter()
+        try:
+            header, payload, closer = read_entry(path)
+            try:
+                if header.get("key") != key:
+                    raise CacheReject("key_mismatch", path)
+                if header.get("env") != self.env():
+                    raise CacheReject("env_mismatch", path)
+                try:
+                    serialized, in_tree, out_tree = pickle.loads(payload)
+                    from jax.experimental.serialize_executable import (
+                        deserialize_and_load,
+                    )
+
+                    fn = deserialize_and_load(serialized, in_tree,
+                                              out_tree)
+                except CacheReject:
+                    raise
+                except Exception as e:  # noqa: BLE001 — any deserializer
+                    # failure is a reject, never a crash
+                    raise CacheReject(
+                        "deserialize_failed",
+                        f"{path}: {type(e).__name__}: {e}") from None
+            finally:
+                closer()
+        except CacheReject as e:
+            if obs is not None:
+                obs.registry.counter("arbius_aot_cache_rejects_total",
+                                     _REJECTS_HELP).inc()
+                obs.event("aot_cache_reject", key=key, tag=tag,
+                          reason=e.reason)
+            return None
+        touch(path)
+        if obs is not None:
+            obs.registry.counter("arbius_aot_cache_loads_total",
+                                 _LOADS_HELP).inc()
+            obs.registry.histogram(
+                "arbius_aot_load_seconds", _LOAD_SECONDS_HELP).observe(
+                # detlint: allow[DET101] obs load timing; never reaches solve bytes
+                time.perf_counter() - t0, tag=tag)
+        return fn
+
+    def store(self, key: str, compiled, *, program: str = "",
+              arg_sig: str = "", tag: str | None = None,
+              donate_sig: str = "") -> str | None:
+        """Serialize + publish one compiled executable (atomic), then
+        enforce the LRU budget. The header records the key's derivation
+        components so `--verify` can re-derive it offline.
+
+        Write-time self-check: the payload is loaded BACK through the
+        exact read path before it may publish. Not paranoia — an
+        executable that was itself served from XLA's persistent
+        compilation cache re-serializes WITHOUT its jitted symbols on
+        CPU (deserialize dies with "Symbols not found"), and a cache
+        that publishes dead entries would reject-and-recompile on every
+        future boot forever. A failed check counts
+        `arbius_aot_cache_skips_total`, journals `aot_cache_skip`, and
+        publishes nothing: the next life simply compiles again."""
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+            serialize,
+        )
+
+        from arbius_tpu.obs import current_obs
+
+        obs = current_obs()
+        try:
+            serialized, in_tree, out_tree = serialize(compiled)
+            payload = pickle.dumps((serialized, in_tree, out_tree))
+            s2, it2, ot2 = pickle.loads(payload)
+            deserialize_and_load(s2, it2, ot2)
+        except Exception as e:  # noqa: BLE001 — an unserializable
+            # executable, or a load-back failure: the entry would be
+            # dead on arrival (and the solve must proceed regardless)
+            if obs is not None:
+                obs.registry.counter("arbius_aot_cache_skips_total",
+                                     _SKIPS_HELP).inc()
+                obs.event("aot_cache_skip", key=key, tag=tag,
+                          reason=f"{type(e).__name__}: "
+                                 f"{str(e)[:120]}")
+            return None
+        header = make_header(key, program, self.env(), arg_sig, payload,
+                             tag=tag, donate_sig=donate_sig,
+                             layout=self.layout)
+        try:
+            path = write_entry(self.dir, key, header, payload)
+        except OSError as e:
+            # a full or read-only shared directory must not fail the
+            # solve that just compiled successfully
+            if obs is not None:
+                obs.registry.counter("arbius_aot_cache_skips_total",
+                                     _SKIPS_HELP).inc()
+                obs.event("aot_cache_skip", key=key, tag=tag,
+                          reason=f"write: {type(e).__name__}: "
+                                 f"{str(e)[:120]}")
+            return None
+        if obs is not None:
+            obs.registry.counter("arbius_aot_cache_writes_total",
+                                 _WRITES_HELP).inc()
+        evicted = evict_lru(self.dir, self.max_bytes, keep=key)
+        if evicted and obs is not None:
+            obs.registry.counter("arbius_aot_cache_evictions_total",
+                                 _EVICT_HELP).inc(len(evicted))
+            obs.event("aot_cache_evict", keys=evicted)
+        return path
+
+    # -- introspection (boot warm scan, CLI, /debug) ---------------------
+    def tags(self) -> frozenset:
+        """Every tag recorded in an entry whose environment AND mesh
+        layout match THIS cache — the cross-life warm set costsched's
+        `warm_boost` counts as warm at boot (docs/scheduler.md). The
+        layout filter is what keeps differently-laid-out workers
+        sharing one directory honest: a dp2 worker's entries are real
+        executables a tp2 worker cannot load, so they must not read as
+        warm to it. Header-only reads — no payload hashing at boot; an
+        unreadable entry is simply absent (the load path journals the
+        reject if a dispatch ever wants it)."""
+        env = self.env()
+        out = set()
+        for _, path, _ in scan(self.dir):
+            try:
+                header = read_header(path)
+            except CacheReject:
+                continue
+            if header.get("env") == env and header.get("tag") and \
+                    header.get("layout", "single") == self.layout:
+                out.add(header["tag"])
+        return frozenset(out)
+
+    def entries(self) -> list[dict]:
+        """[{key, tag, program, payload_len, size}] sorted by key —
+        the deterministic listing `tools/aotcache.py` renders."""
+        out = []
+        for key, path, size in scan(self.dir):
+            try:
+                header = read_header(path)
+            except CacheReject as e:
+                out.append({"key": key, "error": e.reason, "size": size})
+                continue
+            out.append({"key": key, "tag": header.get("tag"),
+                        "program": header.get("program"),
+                        "payload_len": header.get("payload_len"),
+                        "size": size})
+        return out
+
+    def stats(self) -> dict:
+        rows = scan(self.dir)
+        return {"dir": self.dir, "entries": len(rows),
+                "total_bytes": sum(s for _, _, s in rows),
+                "max_bytes": self.max_bytes}
+
+
+__all__ = [
+    "AotCache", "CacheReject", "args_signature", "derive_key",
+    "entry_path", "env_signature", "evict_lru", "make_header",
+    "read_entry", "read_header", "scan", "total_bytes", "touch",
+    "write_entry",
+]

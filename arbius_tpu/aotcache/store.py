@@ -1,0 +1,339 @@
+"""On-disk entry format + key derivation for the AOT executable cache.
+
+One cache entry is one file, `<key>.aotx`, fully self-describing:
+
+    AOTC1\n                      magic + format version
+    <8-digit header length>\n    decimal, zero-padded
+    <header JSON>                sort_keys, utf-8
+    <payload bytes>              pickle of (xla bytes, in_tree, out_tree)
+
+The header carries every component the key was derived FROM (program
+fingerprint, environment signature, argument/sharding signature,
+donation signature) plus the payload's sha256 and length — so
+`tools/aotcache.py --verify` can re-derive each entry's key offline and
+a corrupted, truncated, or renamed file is detected BEFORE its pickle
+is ever touched (`read_entry` hashes the payload against the header
+first).
+
+Key derivation (docs/compile-cache.md): the key is sha256 over
+
+    aotc1 | <graphlint canonical program fingerprint>
+          | <env: jax, jaxlib, platform, device kind, device count>
+          | <args: per-leaf aval + sharding signature>
+          | <donation signature>
+
+The program fingerprint is `analysis.graph.fingerprint` over
+`jax.make_jaxpr(jitted_fn)(*args)` — exactly the canonicalization the
+graphlint goldens pin — so the golden that already defines program
+identity IS the cache key: a drifted program hashes to a different key
+and misses to a fresh compile; a stale executable is structurally
+impossible to load. Everything the fingerprint cannot see (the XLA
+build environment, the physical device layout, donation) rides in the
+other components.
+
+Concurrency: writes go to a per-process tmp file then `os.replace` —
+atomic on POSIX, so fleet workers sharing one cache directory race as
+last-writer-wins and a reader can never observe a torn entry (both
+writers serialize the SAME program, so either winner is correct).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+MAGIC = b"AOTC1\n"
+SUFFIX = ".aotx"
+_LEN_DIGITS = 8
+KEY_SCHEME = "aotc1"
+
+
+class CacheReject(ValueError):
+    """An entry that must not be loaded (corrupt/truncated/mismatched).
+
+    Carries `reason` — the journaled `aot_cache_reject` event's label —
+    so rejects are diagnosable from the flight recorder."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+
+
+# -- key derivation ----------------------------------------------------------
+
+def env_signature() -> dict:
+    """The execution environment a serialized executable is only valid
+    for: jax/jaxlib versions (lowering + runtime ABI), backend platform
+    and device kind (a cpu executable must never load on tpu, a v4
+    executable never on v5p), and the visible device count (device
+    assignment is baked into the compiled program)."""
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "device_count": jax.device_count(),
+    }
+
+
+def args_signature(args) -> str:
+    """Per-leaf aval + sharding digest of the dispatch arguments. The
+    program fingerprint already captures jit-level in_shardings; this
+    covers what it cannot — the COMMITTED placement of the concrete
+    arguments (and their tree structure), so two call sites tracing the
+    same program over differently-placed operands key separately."""
+    import jax
+
+    h = hashlib.sha256()
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    h.update(str(treedef).encode("utf-8"))
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        sharding = getattr(leaf, "sharding", None)
+        h.update(f"{dtype}:{tuple(shape)}:{sharding}\n".encode("utf-8"))
+    return h.hexdigest()
+
+
+def derive_key(program_fingerprint: str, env: dict, arg_sig: str,
+               donate_sig: str = "") -> str:
+    """The content address: sha256 over the four identity components.
+    Pure over its inputs — `tools/aotcache.py --verify` re-derives it
+    from a stored header with no jax tracing involved."""
+    material = "|".join([
+        KEY_SCHEME, program_fingerprint,
+        json.dumps(env, sort_keys=True), arg_sig, donate_sig])
+    return hashlib.sha256(material.encode("utf-8")).hexdigest()
+
+
+def make_header(key: str, program_fingerprint: str, env: dict,
+                arg_sig: str, payload: bytes, *, tag: str | None = None,
+                donate_sig: str = "", layout: str = "single") -> dict:
+    # `layout` is advisory metadata for the warm SCAN only (the mesh
+    # layout of the writer's solve programs — docs/multichip.md
+    # mesh_tag): the cache KEY already separates layouts through the
+    # fingerprint + arg shardings, but a scan cannot trace, so without
+    # this field a tp2 worker would count a dp2 worker's entries as
+    # disk-warm and boost exactly the buckets it cannot load
+    return {
+        "format": 1,
+        "key": key,
+        "program": program_fingerprint,
+        "env": dict(env),
+        "arg_sig": arg_sig,
+        "donate_sig": donate_sig,
+        "tag": tag,
+        "layout": layout,
+        "payload_sha256": hashlib.sha256(payload).hexdigest(),
+        "payload_len": len(payload),
+    }
+
+
+# -- file format -------------------------------------------------------------
+
+def pack_entry(header: dict, payload: bytes) -> bytes:
+    hdr = json.dumps(header, sort_keys=True).encode("utf-8")
+    return b"".join([MAGIC, f"{len(hdr):0{_LEN_DIGITS}d}\n".encode(),
+                     hdr, payload])
+
+
+def entry_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, key + SUFFIX)
+
+
+def write_entry(cache_dir: str, key: str, header: dict,
+                payload: bytes) -> str:
+    """Atomic publish: write to a per-process tmp name, fsync, then
+    `os.replace` onto the final name. Two fleet workers racing on one
+    key are last-writer-wins and every reader sees a complete entry."""
+    os.makedirs(cache_dir, exist_ok=True)
+    path = entry_path(cache_dir, key)
+    tmp = os.path.join(cache_dir, f".{key}.{os.getpid()}.tmp")
+    blob = pack_entry(header, payload)
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def _parse(buf, path: str) -> tuple[dict, object]:
+    """(header, payload view) from a whole-entry buffer; raises
+    CacheReject on any structural problem. The payload's sha256 is
+    verified BEFORE the caller may unpickle it — garbage never reaches
+    the deserializer. Every intermediate view is released on failure
+    (a raised exception's traceback would otherwise pin an export into
+    the caller's mmap and make its close() fail)."""
+    view = memoryview(buf)
+    payload = None
+    ok = False
+    try:
+        hdr_start = len(MAGIC) + _LEN_DIGITS + 1
+        if bytes(view[:len(MAGIC)]) != MAGIC:
+            raise CacheReject("bad_magic", path)
+        try:
+            hdr_len = int(bytes(view[len(MAGIC):hdr_start - 1]))
+        except ValueError:
+            raise CacheReject("bad_header_length", path) from None
+        body = hdr_start + hdr_len
+        try:
+            header = json.loads(
+                bytes(view[hdr_start:body]).decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            raise CacheReject("bad_header", path) from None
+        payload = view[body:]
+        if len(payload) != header.get("payload_len"):
+            raise CacheReject(
+                "truncated", f"{path}: {len(payload)} bytes, header "
+                f"says {header.get('payload_len')}")
+        if hashlib.sha256(payload).hexdigest() != \
+                header.get("payload_sha256"):
+            raise CacheReject("payload_digest_mismatch", path)
+        ok = True
+        return header, payload
+    finally:
+        if not ok and payload is not None:
+            payload.release()
+        view.release()  # the payload slice references the base buffer,
+        # not this view, so releasing it here is always safe
+
+
+def read_entry(path: str) -> tuple[dict, object, object]:
+    """(header, payload view, closer) — the payload is an mmap-backed
+    memoryview (no copy of a multi-hundred-MB executable blob onto the
+    heap just to hash it); call `closer()` once done with the view.
+    Raises CacheReject on anything that must not be deserialized."""
+    import mmap
+
+    try:
+        f = open(path, "rb")
+    except OSError as e:
+        raise CacheReject("unreadable", f"{path}: {e}") from None
+    try:
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except (ValueError, OSError) as e:  # empty file / mmap failure
+            raise CacheReject("unreadable", f"{path}: {e}") from None
+        try:
+            header, payload = _parse(mm, path)
+        except CacheReject:
+            mm.close()
+            raise
+
+        def closer(mm=mm, payload=payload):
+            # the payload view exports a pointer into the mmap — it must
+            # release first or mm.close() raises BufferError
+            payload.release()
+            mm.close()
+
+        return header, payload, closer
+    finally:
+        f.close()  # the mmap keeps its own reference to the file
+
+
+def read_header(path: str) -> dict:
+    """Header only — the CHEAP read for warm-set scans and listings:
+    parses magic + header JSON and stat-checks the payload LENGTH, but
+    does NOT hash the payload (a boot scan over a shared cache of
+    multi-hundred-MB executables must not re-digest gigabytes to
+    collect tag strings). The load path (`read_entry`) still verifies
+    the digest before anything is unpickled, and `--verify` audits it
+    offline — a silently bit-flipped payload is caught exactly where
+    it matters."""
+    hdr_start = len(MAGIC) + _LEN_DIGITS + 1
+    try:
+        size = os.stat(path).st_size
+        with open(path, "rb") as f:
+            head = f.read(hdr_start)
+            if len(head) < hdr_start or head[:len(MAGIC)] != MAGIC:
+                raise CacheReject("bad_magic", path)
+            try:
+                hdr_len = int(head[len(MAGIC):hdr_start - 1])
+            except ValueError:
+                raise CacheReject("bad_header_length", path) from None
+            raw = f.read(hdr_len)
+    except OSError as e:
+        raise CacheReject("unreadable", f"{path}: {e}") from None
+    if len(raw) < hdr_len:
+        raise CacheReject("bad_header", path)
+    try:
+        header = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        raise CacheReject("bad_header", path) from None
+    if size - hdr_start - hdr_len != header.get("payload_len"):
+        raise CacheReject(
+            "truncated", f"{path}: {size - hdr_start - hdr_len} payload "
+            f"bytes, header says {header.get('payload_len')}")
+    return header
+
+
+# -- directory-level operations ---------------------------------------------
+
+def scan(cache_dir: str) -> list[tuple[str, str, int]]:
+    """[(key, path, size)] for every entry file, sorted by key —
+    deterministic regardless of filesystem enumeration order."""
+    try:
+        names = sorted(os.listdir(cache_dir))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not name.endswith(SUFFIX) or name.startswith("."):
+            continue
+        path = os.path.join(cache_dir, name)
+        try:
+            size = os.stat(path).st_size
+        except OSError:
+            continue  # evicted/replaced under our feet: not an error
+        out.append((name[:-len(SUFFIX)], path, size))
+    return out
+
+
+def total_bytes(cache_dir: str) -> int:
+    return sum(size for _, _, size in scan(cache_dir))
+
+
+def evict_lru(cache_dir: str, max_bytes: int,
+              keep: str | None = None) -> list[str]:
+    """Delete least-recently-used entries (st_mtime order, name as the
+    tiebreak) until the directory fits `max_bytes`. `keep` protects the
+    just-written key — a cache whose budget is smaller than one entry
+    degrades to holding that one entry rather than thrashing it.
+    Returns the evicted keys, oldest first."""
+    if max_bytes <= 0:
+        return []
+    entries = []
+    for key, path, size in scan(cache_dir):
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            continue
+        entries.append((mtime, key, path, size))
+    total = sum(e[3] for e in entries)
+    evicted: list[str] = []
+    for mtime, key, path, size in sorted(entries):
+        if total <= max_bytes:
+            break
+        if key == keep:
+            continue
+        try:
+            os.remove(path)
+        except OSError:
+            continue  # another worker evicted it first
+        total -= size
+        evicted.append(key)
+    return evicted
+
+
+def touch(path: str) -> None:
+    """Best-effort LRU bump on a load hit (mtime is the eviction
+    clock; a read-only shared cache directory just stays untouched)."""
+    try:
+        os.utime(path, None)
+    except OSError:
+        pass

@@ -156,10 +156,20 @@ class Kandinsky2Pipeline:
                         steps: int, scheduler: str):
         return self._get_bucket(batch, height, width, steps, scheduler)[0]
 
+    @staticmethod
+    def bucket_tag(batch: int, height: int, width: int, steps: int,
+                   scheduler: str) -> str:
+        """One definition of this family's executable-cache tag — the
+        warm sets and the AOT disk-warm scan join on it
+        (docs/compile-cache.md)."""
+        return "kandinsky2." + ".".join(
+            str(k) for k in (batch, height, width, steps, scheduler))
+
     def _get_bucket(self, batch: int, height: int, width: int,
-                    steps: int, scheduler: str):
+                    steps: int, scheduler: str, aot_args=None):
         """(fn, warm, tag) — cache lookup reported through the
-        jit-cache metrics (docs/observability.md)."""
+        jit-cache metrics (docs/observability.md); `aot_args` opts into
+        the AOT disk tier (docs/compile-cache.md)."""
         from arbius_tpu.obs import jit_cache_get
 
         key = (batch, height, width, steps, scheduler)
@@ -167,7 +177,7 @@ class Kandinsky2Pipeline:
             self._buckets, key,
             lambda: self._build_bucket(batch, height, width, steps,
                                        scheduler),
-            tag="kandinsky2." + ".".join(str(k) for k in key))
+            tag=self.bucket_tag(*key), aot_args=aot_args)
 
     def _build_bucket(self, batch: int, height: int, width: int,
                       steps: int, scheduler: str):
@@ -262,8 +272,6 @@ class Kandinsky2Pipeline:
             raise ValueError(f"height/width must be multiples of {granule}")
         g = list(guidance_scale) if isinstance(guidance_scale, (list, tuple)) \
             else [guidance_scale] * batch
-        fn, warm, tag = self._get_bucket(batch, height, width,
-                                         num_inference_steps, scheduler)
         ids = self.tokenizer.encode_batch(prompts)
         vocab = self.config.text.vocab_size
         if int(ids.max()) >= vocab:
@@ -277,6 +285,11 @@ class Kandinsky2Pipeline:
             jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
+        # args before the lookup: the AOT tier keys against the exact
+        # dispatch operands (docs/compile-cache.md)
+        fn, warm, tag = self._get_bucket(
+            batch, height, width, num_inference_steps, scheduler,
+            aot_args=lambda: (params, *args))
         from arbius_tpu.obs import timed_dispatch
 
         with timed_dispatch(warm, tag):

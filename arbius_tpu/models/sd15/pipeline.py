@@ -162,12 +162,24 @@ class SD15Pipeline:
                    steps: int, scheduler: str):
         return self._get_bucket(batch, height, width, steps, scheduler)[0]
 
+    @staticmethod
+    def bucket_tag(batch: int, height: int, width: int, steps: int,
+                   scheduler: str) -> str:
+        """The ONE definition of this family's executable-cache tag —
+        the jit-cache warm set, the AOT cache's disk-warm scan, and the
+        scheduler's cross-life warm boost all join on this string
+        (docs/compile-cache.md), so it may never be rebuilt ad hoc."""
+        return "sd15." + ".".join(
+            str(k) for k in (batch, height, width, steps, scheduler))
+
     def _get_bucket(self, batch: int, height: int, width: int,
-                    steps: int, scheduler: str):
+                    steps: int, scheduler: str, aot_args=None):
         """(fn, warm, tag) — the cached bucket executable, whether it
         was already built, and its cache tag; the lookup reports
         through the jit-cache metrics (docs/observability.md) so
-        warm-executable reuse is fleet-visible."""
+        warm-executable reuse is fleet-visible. `aot_args` (the exact
+        dispatch arguments, as a thunk) opts the lookup into the AOT
+        disk tier when one is installed (docs/compile-cache.md)."""
         from arbius_tpu.obs import jit_cache_get
 
         key = (batch, height, width, steps, scheduler)
@@ -175,7 +187,7 @@ class SD15Pipeline:
             self._buckets, key,
             lambda: self._build_bucket(batch, height, width, steps,
                                        scheduler),
-            tag="sd15." + ".".join(str(k) for k in key))
+            tag=self.bucket_tag(*key), aot_args=aot_args)
 
     def _build_bucket(self, batch: int, height: int, width: int,
                       steps: int, scheduler: str):
@@ -276,8 +288,6 @@ class SD15Pipeline:
             else [guidance_scale] * batch
         if len(g) != batch:
             raise ValueError("guidance_scale list must align with prompts")
-        fn, warm, tag = self._get_bucket(batch, height, width,
-                                         num_inference_steps, scheduler)
         ids_c = self.tokenizer.encode_batch(prompts)
         ids_u = self.tokenizer.encode_batch(negative_prompts)
         vocab = self.config.text.vocab_size
@@ -293,6 +303,11 @@ class SD15Pipeline:
             jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
             jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32),
         )
+        # args are built BEFORE the bucket lookup so the AOT tier can
+        # key (and compile) against the exact dispatch operands
+        fn, warm, tag = self._get_bucket(
+            batch, height, width, num_inference_steps, scheduler,
+            aot_args=lambda: (params, *args))
         from arbius_tpu.obs import timed_dispatch
 
         with timed_dispatch(warm, tag):

@@ -128,10 +128,22 @@ class Text2VideoPipeline:
         return self._get_bucket(batch, frames, height, width, steps,
                                 scheduler)[0]
 
+    @staticmethod
+    def bucket_tag(batch: int, frames: int, height: int, width: int,
+                   steps: int, scheduler: str) -> str:
+        """One definition of this family's executable-cache tag — the
+        warm sets and the AOT disk-warm scan join on it
+        (docs/compile-cache.md)."""
+        return "video." + ".".join(
+            str(k) for k in (batch, frames, height, width, steps,
+                             scheduler))
+
     def _get_bucket(self, batch: int, frames: int, height: int,
-                    width: int, steps: int, scheduler: str):
+                    width: int, steps: int, scheduler: str,
+                    aot_args=None):
         """(fn, warm, tag) — cache lookup reported through the
-        jit-cache metrics (docs/observability.md)."""
+        jit-cache metrics (docs/observability.md); `aot_args` opts into
+        the AOT disk tier (docs/compile-cache.md)."""
         from arbius_tpu.obs import jit_cache_get
 
         key = (batch, frames, height, width, steps, scheduler)
@@ -139,7 +151,7 @@ class Text2VideoPipeline:
             self._buckets, key,
             lambda: self._build_bucket(batch, frames, height, width,
                                        steps, scheduler),
-            tag="video." + ".".join(str(k) for k in key))
+            tag=self.bucket_tag(*key), aot_args=aot_args)
 
     def _build_bucket(self, batch: int, frames: int, height: int,
                       width: int, steps: int, scheduler: str):
@@ -235,9 +247,6 @@ class Text2VideoPipeline:
             raise ValueError(f"height/width must be multiples of {granule}")
         g = list(guidance_scale) if isinstance(guidance_scale, (list, tuple)) \
             else [guidance_scale] * batch
-        fn, warm, tag = self._get_bucket(batch, num_frames, height,
-                                         width, num_inference_steps,
-                                         scheduler)
         ids_c = self.tokenizer.encode_batch(prompts)
         ids_u = self.tokenizer.encode_batch(negs)
         vocab = self.config.text.vocab_size
@@ -246,14 +255,19 @@ class Text2VideoPipeline:
                 f"tokenizer produced id >= vocab_size ({vocab}); "
                 "tokenizer and text-encoder config are mismatched")
         seeds_arr = np.asarray(seeds, dtype=np.uint64)
+        args = (jnp.asarray(ids_c), jnp.asarray(ids_u),
+                jnp.asarray(g, jnp.float32),
+                jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
+                jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32))
+        # args before the lookup: the AOT tier keys against the exact
+        # dispatch operands (docs/compile-cache.md)
+        fn, warm, tag = self._get_bucket(
+            batch, num_frames, height, width, num_inference_steps,
+            scheduler, aot_args=lambda: (params, *args))
         from arbius_tpu.obs import timed_dispatch
 
         with timed_dispatch(warm, tag):
-            out = fn(params,
-                     jnp.asarray(ids_c), jnp.asarray(ids_u),
-                     jnp.asarray(g, jnp.float32),
-                     jnp.asarray(seeds_arr & 0xFFFFFFFF, jnp.uint32),
-                     jnp.asarray(seeds_arr >> np.uint64(32), jnp.uint32))
+            out = fn(params, *args)
         if self.mesh is not None:
             from arbius_tpu.parallel import meshsolve
 

@@ -145,6 +145,41 @@ class SchedConfig:
 
 
 @dataclass(frozen=True)
+class AotCacheConfig:
+    """Fleet-wide AOT executable cache (docs/compile-cache.md): persist
+    compiled bucket executables on disk, keyed by the graphlint
+    canonical program fingerprint + environment signature, so a warm
+    boot deserializes instead of re-compiling (the cold-boot compile
+    storm `arbius_compile_seconds` meters). The directory may be SHARED
+    by every fleet worker on a host — writes are atomic tmp+rename.
+
+    Disabled by default — `enabled: false` IS the memory-only
+    executable caching the node always had, bit-for-bit. Enabling only
+    changes WHERE an executable comes from, never its program: a
+    drifted program hashes to a different key and misses to a fresh
+    compile (tests/test_aotcache.py pins CID byte-equality on vs off)."""
+    enabled: bool = False
+    # shared cache directory (created on first write)
+    dir: str = "aot-cache"
+    # LRU size budget in bytes; 0 = unbounded. Enforced after each
+    # write (oldest-mtime entries evicted first; the just-written entry
+    # is always retained, so the budget is a soft ceiling of one entry)
+    max_bytes: int = 0
+
+    def __post_init__(self):
+        if self.enabled and not self.dir:
+            raise ConfigError("aot_cache.dir must be a directory path "
+                              "when aot_cache.enabled is true")
+        if self.dir == ":memory:":
+            raise ConfigError("aot_cache.dir must be a directory path — "
+                              "the cache is shared across lives (and "
+                              "fleet workers)")
+        if self.max_bytes < 0:
+            raise ConfigError("aot_cache.max_bytes must be >= 0 "
+                              "(0 = unbounded)")
+
+
+@dataclass(frozen=True)
 class SLOConfig:
     """First-class service-level objectives over the fleet's chain-time
     latency corpus (docs/fleetscope.md): each threshold declares an
@@ -339,6 +374,9 @@ class MiningConfig:
     # service-level objectives over the chain-time latency corpus
     # (docs/fleetscope.md); all-null = report percentiles, fail nothing
     slo: SLOConfig = SLOConfig()
+    # fleet-wide AOT executable cache (docs/compile-cache.md); default
+    # OFF = memory-only bucket caching, compile on every boot
+    aot_cache: AotCacheConfig = AotCacheConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -433,8 +471,10 @@ def load_config(raw: str | dict) -> MiningConfig:
     sched = build(SchedConfig, obj.pop("sched", {}), "sched")
     fleet = build(FleetConfig, obj.pop("fleet", {}), "fleet")
     slo = build(SLOConfig, obj.pop("slo", {}), "slo")
+    aot_cache = build(AotCacheConfig, obj.pop("aot_cache", {}),
+                      "aot_cache")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
                       ipfs=ipfs, pipeline=pipeline, sched=sched,
-                      fleet=fleet, slo=slo, **obj),
+                      fleet=fleet, slo=slo, aot_cache=aot_cache, **obj),
                  "config")

@@ -165,6 +165,12 @@ class MinerNode:
         self.task_feed = None
         self.commit_guard = None
         self.mesh = None          # built + validated at boot (cfg.mesh)
+        # AOT executable cache (docs/compile-cache.md), installed at
+        # boot when cfg.aot_cache.enabled; the disk-warm tag set feeds
+        # costsched's CROSS-LIFE warm boost (published under state_lock
+        # — the /debug/costmodel request thread reads it)
+        self.aot_cache = None
+        self._disk_warm_tags: frozenset = frozenset()
         # mesh-layout tag of the solve programs (part of every cost-model
         # key: a tp2 bucket and a single-device bucket are different
         # programs with different chip-seconds); boot() refines it once
@@ -241,6 +247,30 @@ class MinerNode:
         meshsolve.check_mesh_contract(self.mesh,
                                       mesh_contracts(self.config),
                                       self.config.canonical_batch)
+        if self.config.aot_cache.enabled:
+            # AOT executable cache (docs/compile-cache.md): installed
+            # AFTER the mesh so the cache carries this node's solve
+            # layout — published headers are stamped with it and the
+            # warm scan filters on it, so differently-laid-out workers
+            # sharing one directory never count each other's entries
+            # as disk-warm. On the obs bundle so every jit_cache_get
+            # under this node's ambient obs — including the boot
+            # self-test below — gains the disk tier; the directory's
+            # tags are scanned ONCE so disk-warm buckets count as warm
+            # for the packer at boot (the cross-life half of
+            # sched.warm_boost).
+            from arbius_tpu.aotcache import AotCache
+
+            self.aot_cache = AotCache(
+                self.config.aot_cache.dir,
+                max_bytes=self.config.aot_cache.max_bytes,
+                layout=self.solve_layout)
+            self.obs.aot_cache = self.aot_cache
+            warm = self.aot_cache.tags()
+            with self.state_lock:
+                self._disk_warm_tags = warm
+            if warm:
+                self.obs.event("aot_cache_warm", tags=sorted(warm))
         self.db.clear_jobs_by_method("validatorStake")
         self.db.clear_jobs_by_method("automine")
         if self.chain.version() > MINER_VERSION:
@@ -656,6 +686,25 @@ class MinerNode:
                            cost_floor=str(floor), source=source,
                            verdict="accept" if ok else "reject")
         return ok
+
+    def bucket_disk_warm(self, key: tuple, entries: list) -> bool:
+        """Cross-life warm signal for the packer (docs/compile-cache.md):
+        True when this bucket's executable is already serialized in the
+        AOT cache — a boot-scanned tag-set lookup, no disk I/O per pack.
+        The join key is the runner's `cache_tag` (which defers to the
+        pipeline's one `bucket_tag` definition), so the scheduler's
+        notion of "disk warm" can never drift from what a dispatch
+        would actually load. Called under the state lock (the pack)."""
+        tags = self._disk_warm_tags
+        if not tags:
+            return False
+        m = self.registry.get(key[0])
+        cache_tag = getattr(m.runner, "cache_tag", None) \
+            if m is not None else None
+        if cache_tag is None:
+            return False
+        tag = cache_tag(entries[0][1], max(1, self.config.canonical_batch))
+        return tag in tags
 
     def _bucket_fees(self, entries: list) -> int:
         """Summed task fees of one bucket (the packer's reward side):

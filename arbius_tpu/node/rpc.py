@@ -594,6 +594,11 @@ class ControlRPC:
                     # lock there), so this read iterates an immutable
                     # snapshot, not a mutating set
                     "jit_warm": sorted(self.node.obs.jit_warm),
+                    # cross-life warm set (docs/compile-cache.md): tags
+                    # the boot scan found serialized in the AOT cache —
+                    # the packer's disk-warm half; empty when aot_cache
+                    # is disabled
+                    "aot_disk_warm": sorted(self.node._disk_warm_tags),
                     "layout": self.node.solve_layout,
                     "min_fee_per_second": str(cfg.min_fee_per_second),
                     "static_seconds": self.node._static_solve_seconds(),

@@ -86,10 +86,13 @@ class CostSched(FifoSched):
     def __init__(self, node, cfg):
         self.node = node
         self.cfg = cfg
-        # bucket keys whose executable compiled this life — compile
-        # caches die with the process, so warmth is per-life by
-        # construction (the arbius_jit_cache_* counters expose the same
-        # signal fleet-wide)
+        # bucket keys whose executable compiled this life. With an AOT
+        # cache installed (docs/compile-cache.md) warmth is additionally
+        # CROSS-life: `node.bucket_disk_warm` consults the boot-scanned
+        # disk-warm tag set, so a freshly booted worker already prefers
+        # buckets it can deserialize in milliseconds over ones it would
+        # have to compile (the arbius_jit_cache_* tier counters expose
+        # the same signal fleet-wide)
         self._warm: set[tuple] = set()
         self._last: list[PackedBucket] = []
 
@@ -117,7 +120,8 @@ class CostSched(FifoSched):
         scored: list[PackedBucket] = []
         for key, entries, fee_sum in buckets:
             seconds, source = self._predict(key, len(entries))
-            warm = key in self._warm
+            warm = key in self._warm \
+                or self.node.bucket_disk_warm(key, entries)
             score = float(fee_sum) / max(seconds, 1e-9)
             if warm:
                 score *= self.cfg.warm_boost

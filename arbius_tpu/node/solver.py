@@ -229,6 +229,17 @@ class Kandinsky2Runner:
             return [{self.out_name: encode_png(images[i])}
                     for i in range(n_real)]
 
+    def cache_tag(self, hydrated: dict, batch: int) -> str:
+        """The executable-cache tag a dispatch of this task's bucket
+        would use — defaults mirror `dispatch` exactly, and the string
+        comes from the pipeline's one `bucket_tag` definition, so the
+        scheduler's cross-life disk-warm lookup (docs/compile-cache.md)
+        can never drift from what the dispatch actually caches."""
+        return self.pipeline.bucket_tag(
+            batch, int(hydrated.get("height", 768)),
+            int(hydrated.get("width", 768)),
+            int(hydrated.get("num_inference_steps", 50)), "DDIM")
+
 
 class Text2VideoRunner:
     """zeroscope/damo-template runner: UNet3D → deterministic H.264 MP4.
@@ -297,6 +308,15 @@ class Text2VideoRunner:
             frames = gather_canonical(frames)
             return [{self.out_name: encode_mp4_h264(frames[i], fps=fps[i])}
                     for i in range(n_real)]
+
+    def cache_tag(self, hydrated: dict, batch: int) -> str:
+        """Scheduler's cross-life disk-warm join key — defaults mirror
+        `dispatch` exactly (docs/compile-cache.md, see
+        SD15Runner.cache_tag)."""
+        g = lambda k: self._get(hydrated, k)  # noqa: E731
+        return self.pipeline.bucket_tag(
+            batch, int(g("num_frames")), int(g("height")),
+            int(g("width")), int(g("num_inference_steps")), "DDIM")
 
 
 class RVMRunner:
@@ -387,3 +407,13 @@ class SD15Runner:
             images = gather_canonical(images)
             return [{self.out_name: encode_png(images[i])}
                     for i in range(n_real)]
+
+    def cache_tag(self, hydrated: dict, batch: int) -> str:
+        """Scheduler's cross-life disk-warm join key — defaults mirror
+        `dispatch` exactly (docs/compile-cache.md, see
+        Kandinsky2Runner.cache_tag)."""
+        return self.pipeline.bucket_tag(
+            batch, int(hydrated.get("height", 512)),
+            int(hydrated.get("width", 512)),
+            int(hydrated.get("num_inference_steps", 20)),
+            hydrated.get("scheduler", "DDIM"))

@@ -61,6 +61,12 @@ class Obs:
         # mid-sorted() raises RuntimeError — frozenset rebinding makes
         # every reader see an immutable snapshot (docs/concurrency.md)
         self.jit_warm: frozenset = frozenset()
+        # AOT executable cache (docs/compile-cache.md): the node installs
+        # its `aotcache.AotCache` here at boot so `jit_cache_get` finds
+        # the disk tier through the SAME ambient plumbing every dispatch
+        # path already rides — None = the memory-only pre-AOT behavior,
+        # bit-for-bit
+        self.aot_cache = None
 
     def span(self, name: str, **attrs):
         if not self.enabled:
@@ -115,7 +121,9 @@ def span(name: str, **attrs):
 # like span(): library code stays node-free.
 
 _JIT_HITS_HELP = ("Bucket-executable cache lookups answered by an "
-                  "already-built (warm) executable")
+                  "already-built (warm) executable, by tier — "
+                  "tier=\"memory\" is this life's dict, tier=\"disk\" "
+                  "is an AOT cache deserialize (docs/compile-cache.md)")
 _JIT_MISS_HELP = ("Bucket-executable cache lookups that had to build "
                   "(trace + compile) a new executable")
 _COMPILE_HELP = ("Wall seconds of a bucket executable's first dispatch "
@@ -123,21 +131,58 @@ _COMPILE_HELP = ("Wall seconds of a bucket executable's first dispatch "
                  "cache key in the recent window)")
 
 
-def jit_cache_get(cache: dict, key, build, tag: str | None = None):
+def jit_cache_get(cache: dict, key, build, tag: str | None = None,
+                  aot_args=None):
     """Get-or-build a cached bucket executable with jit-cache obs:
-    increments `arbius_jit_cache_{hits,misses}_total`, records `tag`
-    into the active obs' warm set on build, and returns
-    `(fn, warm, tag)` — `fn` is exactly what `build()` returned
-    (graphlint traces these same callables, so nothing may wrap them),
-    and `tag` echoes the argument so dispatch sites hand the SAME
-    string to `timed_dispatch` instead of rebuilding it."""
+    increments `arbius_jit_cache_{hits,misses}_total` (hits carry a
+    `tier` label: "memory" for this life's dict, "disk" for an AOT
+    cache load), records `tag` into the active obs' warm set, and
+    returns `(fn, warm, tag)` — `tag` echoes the argument so dispatch
+    sites hand the SAME string to `timed_dispatch` instead of
+    rebuilding it.
+
+    Without an AOT tier, `fn` is exactly what `build()` returned
+    (graphlint traces these same callables, so nothing may wrap them)
+    and `warm=False` tells the dispatch site to time its first —
+    compile-dominated — call. The disk tier engages only when BOTH an
+    `AotCache` is installed on the active obs (`obs.aot_cache`,
+    docs/compile-cache.md) and the call site passed `aot_args` (a
+    zero-arg thunk returning the exact dispatch arguments, for tracing
+    the program's cache key): memory miss → disk load (deserialize, no
+    compile) → trace+compile and write back. Either way the returned
+    executable is ALREADY compiled, so `warm=True` — the compile/load
+    cost was recorded inside (`arbius_compile_seconds` /
+    `arbius_aot_load_seconds`) and the first dispatch has nothing left
+    to time."""
     obs = _ACTIVE.get()
     fn = cache.get(key)
     if fn is not None:
         if obs is not None:
             obs.registry.counter("arbius_jit_cache_hits_total",
-                                 _JIT_HITS_HELP).inc()
+                                 _JIT_HITS_HELP,
+                                 labelnames=("tier",)).inc(tier="memory")
         return fn, True, tag
+    aot = obs.aot_cache if obs is not None else None
+    if aot is not None and aot_args is not None:
+        fn, state = aot.get_or_compile(build, aot_args, tag=tag)
+        cache[key] = fn
+        if state == "disk":
+            obs.registry.counter("arbius_jit_cache_hits_total",
+                                 _JIT_HITS_HELP,
+                                 labelnames=("tier",)).inc(tier="disk")
+        else:
+            obs.registry.counter("arbius_jit_cache_misses_total",
+                                 _JIT_MISS_HELP).inc()
+        if tag is not None:
+            # warm in every state: disk/compiled executables exist in
+            # THIS life now, and a fallback compiles at first dispatch
+            # — the same moment the pre-AOT path records warmth
+            # (copy-on-write publish — see the comment below)
+            obs.jit_warm = obs.jit_warm | {tag}
+        # "fallback" handed back the LAZY jitted callable (the cache
+        # could not even derive a key): warm=False so the dispatch site
+        # times the first call, exactly the pre-AOT contract
+        return fn, state != "fallback", tag
     if obs is not None:
         obs.registry.counter("arbius_jit_cache_misses_total",
                              _JIT_MISS_HELP).inc()

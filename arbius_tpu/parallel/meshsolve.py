@@ -384,16 +384,29 @@ class ShardedImageProbe(_ProbeBase):
     def _fn(self, batch: int):
         return self._get_fn(batch)[0]
 
-    def _get_fn(self, batch: int):
+    @staticmethod
+    def bucket_tag(batch: int) -> str:
+        return f"meshprobe.img.b{batch}"
+
+    def cache_tag(self, hydrated: dict, batch: int) -> str:
+        """The tag a dispatch of this bucket would cache under — the
+        scheduler's cross-life disk-warm join key
+        (docs/compile-cache.md)."""
+        del hydrated  # probe buckets key on batch alone
+        return self.bucket_tag(batch)
+
+    def _get_fn(self, batch: int, aot_args=None):
         """(fn, warm, tag) via the shared jit-cache obs helper
         (docs/observability.md) — the probes report warm-executable
         reuse exactly like the model pipelines, so bench `sched_ab` and
-        the simnet flood see real jit-cache counters."""
+        the simnet flood see real jit-cache counters (and, with an AOT
+        cache installed, real disk-tier traffic)."""
         from arbius_tpu.obs import jit_cache_get
 
         return jit_cache_get(self._fns, batch,
                              lambda: self._build_fn(batch),
-                             tag=f"meshprobe.img.b{batch}")
+                             tag=self.bucket_tag(batch),
+                             aot_args=aot_args)
 
     def _build_fn(self, batch: int):
         import jax
@@ -429,7 +442,8 @@ class ShardedImageProbe(_ProbeBase):
                 else jax.device_put(raw)
         seeds = self._seeds(items)
         (seeds_dev,) = shard_batch(self.mesh, seeds)
-        fn, warm, tag = self._get_fn(len(items))
+        fn, warm, tag = self._get_fn(
+            len(items), aot_args=lambda: (self._params, seeds_dev))
         with timed_dispatch(warm, tag):
             out = fn(self._params, seeds_dev)
         record_bucket_estimate(self._est, len(items), self.mesh, out,
@@ -457,7 +471,16 @@ class ShardedSeqProbe(_ProbeBase):
     def _fn(self, batch: int):
         return self._get_fn(batch)[0]
 
-    def _get_fn(self, batch: int):
+    def bucket_tag(self, batch: int) -> str:
+        return f"meshprobe.seq.b{batch}.f{self.frames}"
+
+    def cache_tag(self, hydrated: dict, batch: int) -> str:
+        """Scheduler's cross-life disk-warm join key
+        (docs/compile-cache.md) — see ShardedImageProbe.cache_tag."""
+        del hydrated
+        return self.bucket_tag(batch)
+
+    def _get_fn(self, batch: int, aot_args=None):
         from arbius_tpu.obs import jit_cache_get
 
         def build():
@@ -471,7 +494,8 @@ class ShardedSeqProbe(_ProbeBase):
             return build_seq_probe_fn(mesh, self.frames)
 
         return jit_cache_get(self._fns, batch, build,
-                             tag=f"meshprobe.seq.b{batch}.f{self.frames}")
+                             tag=self.bucket_tag(batch),
+                             aot_args=aot_args)
 
     def dispatch(self, items: list):
         if self.gate is not None:
@@ -484,7 +508,8 @@ class ShardedSeqProbe(_ProbeBase):
             self._params = jax.device_put(_probe_params())
         seeds = self._seeds(items)
         (seeds_dev,) = shard_batch(self.mesh, seeds)
-        fn, warm, tag = self._get_fn(len(items))
+        fn, warm, tag = self._get_fn(
+            len(items), aot_args=lambda: (self._params, seeds_dev))
         with timed_dispatch(warm, tag):
             out = fn(self._params, seeds_dev)
         record_bucket_estimate(self._est, len(items), self.mesh, out,

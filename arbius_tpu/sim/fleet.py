@@ -93,14 +93,25 @@ class FleetSimHarness(SimHarness):
     """SimHarness world + a fleet instead of one node. The scenario
     MUST carry a FleetSpec. Workers run with the staged pipeline OFF
     (the fleet layer is schedule-transparent; pipeline×fault coverage
-    is the single-node matrix's job)."""
+    is the single-node matrix's job).
+
+    `aot_dir` (docs/compile-cache.md) swaps the hash-fake FaultyRunner
+    for meshsolve's image probe — a REAL jitted XLA program, gated by
+    the fault plane exactly like the fake — and points every worker's
+    `aot_cache` config at that ONE shared directory: the first worker
+    to dispatch a bucket compiles and publishes it, the rest
+    deserialize, and SIM101-112 must hold over the whole run with zero
+    `aot_cache_reject` events in a clean scenario
+    (tests/test_aotcache.py pins it)."""
 
     def __init__(self, scenario: Scenario, seed: int, workdir: str,
-                 node_cls: type[MinerNode] = MinerNode):
+                 node_cls: type[MinerNode] = MinerNode,
+                 aot_dir: str | None = None):
         if scenario.fleet is None:
             raise ValueError(f"scenario {scenario.name!r} has no fleet "
                              "spec — use SimHarness")
         self.workdir = workdir
+        self.aot_dir = aot_dir
         self.workers: list[MinerNode] = []
         self.feeds: list[LeaseFeed] = []
         self.sidecars: list[ObsSidecar] = []
@@ -176,6 +187,8 @@ class FleetSimHarness(SimHarness):
                                  tx_guard=tx_guard)
         chain = AuditedRpcChain(client, self.dev.token_address,
                                 self.plane)
+        from arbius_tpu.node.config import AotCacheConfig
+
         cfg = MiningConfig(
             db_path=":memory:",  # unused: db object injected below
             models=tuple(ModelConfig(id=mid, template="anythingv3")
@@ -184,8 +197,18 @@ class FleetSimHarness(SimHarness):
             obs_journal_capacity=16384,
             retry_max_delay=self.result.retry_max_delay,
             pipeline=PipelineConfig(),
+            aot_cache=AotCacheConfig(enabled=True, dir=self.aot_dir)
+            if self.aot_dir else AotCacheConfig(),
             canonical_batch=1)
-        runner = FaultyRunner(self.plane)
+        if self.aot_dir:
+            # real XLA through the shared executable cache: the probe's
+            # bytes are pure in (input, seed), so every SIM1xx check
+            # audits unchanged whether a worker compiled or deserialized
+            from arbius_tpu.parallel.meshsolve import ShardedImageProbe
+
+            runner = ShardedImageProbe(gate=self.plane.runner_gate)
+        else:
+            runner = FaultyRunner(self.plane)
         registry = ModelRegistry()
         for mid in self.model_ids:
             registry.register(RegisteredModel(
@@ -275,13 +298,14 @@ class FleetSimHarness(SimHarness):
 
 
 def run_fleet_scenario(scenario: Scenario, seed: int, *, workdir: str,
-                       node_cls: type[MinerNode] = MinerNode
-                       ) -> SimResult:
+                       node_cls: type[MinerNode] = MinerNode,
+                       aot_dir: str | None = None) -> SimResult:
     """One-call front door for fleet scenarios (the fleet analogue of
     harness.run_scenario); `node_cls` injects buggy WORKERS
-    (sim/bugs.py double-lease)."""
+    (sim/bugs.py double-lease), `aot_dir` shares one AOT executable
+    cache across every worker (docs/compile-cache.md)."""
     return FleetSimHarness(scenario, seed, workdir,
-                           node_cls=node_cls).run()
+                           node_cls=node_cls, aot_dir=aot_dir).run()
 
 
 # ---------------------------------------------------------------------------

@@ -554,7 +554,8 @@ def test_jit_cache_metrics_and_warm_set():
         np.asarray(probe.dispatch(items))   # warm: hit
     reg = obs.registry
     assert reg.counter("arbius_jit_cache_misses_total").value() == 1
-    assert reg.counter("arbius_jit_cache_hits_total").value() == 1
+    assert reg.counter("arbius_jit_cache_hits_total",
+                       labelnames=("tier",)).value(tier="memory") == 1
     h = reg.histogram("arbius_compile_seconds")
     assert h.count() == 1
     assert h.recent()[0][0] == "meshprobe.img.b2"

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""aotcache — inspect, verify, and garbage-collect the AOT executable cache.
+
+The fleet's AOT cache (docs/compile-cache.md) is a directory of
+content-addressed `<key>.aotx` entries whose key derives from the
+graphlint canonical program fingerprint + environment + argument
+signatures. Every entry's header carries its own derivation components,
+so this tool can audit a cache offline — no jax tracing, no devices:
+
+    python tools/aotcache.py --dir aot-cache --list            # entries
+    python tools/aotcache.py --dir aot-cache --stats           # totals
+    python tools/aotcache.py --dir aot-cache --verify          # audit
+    python tools/aotcache.py --dir aot-cache --gc --max-bytes N
+    python tools/aotcache.py --dir aot-cache --list --json
+
+`--verify` re-derives each entry's key from its stored header and
+checks it against the filename (AOT501 on mismatch — a renamed or
+doctored entry), after the payload digest check every read performs
+(AOT502 on a corrupt/truncated entry). Output is byte-deterministic
+for a fixed cache (entries sorted by key; no mtimes in reports) —
+tier-1 pins it against a fixture cache. `--gc` applies the same LRU
+eviction the node runs after each write, down to `--max-bytes`.
+
+Exit codes follow the shared lint contract (tools/_common.py):
+0 clean / 1 findings (--verify) / 2 usage error.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from _common import EXIT_CLEAN, EXIT_USAGE, kv_table, lint_main
+
+from arbius_tpu.analysis.core import Finding  # noqa: E402 (_common fixes path)
+
+
+def build_arg_parser(p):
+    p.add_argument("--dir", default="aot-cache",
+                   help="cache directory (default: aot-cache)")
+    p.add_argument("--list", action="store_true",
+                   help="list entries (key, tag, sizes), sorted by key")
+    p.add_argument("--stats", action="store_true",
+                   help="entry count + byte totals")
+    p.add_argument("--verify", action="store_true",
+                   help="re-derive every entry's key from its header; "
+                        "exit 1 on any mismatch or corrupt entry")
+    p.add_argument("--gc", action="store_true",
+                   help="LRU-evict entries until the directory fits "
+                        "--max-bytes")
+    p.add_argument("--max-bytes", type=int, default=0,
+                   help="size budget for --gc (required, > 0)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (stable: sorted keys)")
+    return p
+
+
+def verify_findings(cache_dir: str) -> list[Finding]:
+    """AOT501 (key does not re-derive from the stored header) and
+    AOT502 (entry unreadable/corrupt/truncated) findings, sorted by
+    entry key. Pure over the directory contents. This is the FULL
+    audit — unlike the boot warm scan's header-only reads, every
+    payload is digest-verified here, so a silently bit-flipped blob
+    surfaces offline instead of as a reject at some future boot."""
+    from arbius_tpu.aotcache import CacheReject, derive_key, read_entry
+    from arbius_tpu.aotcache.store import SUFFIX, scan
+
+    def full_read(path):
+        header, _, closer = read_entry(path)
+        closer()
+        return header
+
+    findings = []
+    for key, path, _size in scan(cache_dir):
+        name = key + SUFFIX
+        try:
+            header = full_read(path)
+        except CacheReject as e:
+            findings.append(Finding(
+                path=name, line=1, col=0, rule="AOT502", severity="error",
+                message=f"unloadable cache entry: {e.reason}",
+                snippet=key))
+            continue
+        derived = derive_key(header.get("program", ""),
+                             header.get("env", {}),
+                             header.get("arg_sig", ""),
+                             header.get("donate_sig", ""))
+        if derived != key or header.get("key") != key:
+            findings.append(Finding(
+                path=name, line=1, col=0, rule="AOT501", severity="error",
+                message=("entry key does not re-derive from its header "
+                         f"(derived {derived[:16]}…, header says "
+                         f"{str(header.get('key'))[:16]}…) — renamed or "
+                         "doctored entry"),
+                snippet=key))
+    return findings
+
+
+def collect(ns):
+    from arbius_tpu.aotcache import AotCache
+    from arbius_tpu.aotcache.store import evict_lru, scan, total_bytes
+
+    modes = [ns.list, ns.stats, ns.verify, ns.gc]
+    if sum(bool(m) for m in modes) != 1:
+        print("exactly one of --list/--stats/--verify/--gc is required",
+              file=sys.stderr)
+        return EXIT_USAGE, []
+    if ns.verify:
+        return None, verify_findings(ns.dir)
+    if ns.gc:
+        if ns.max_bytes <= 0:
+            print("--gc needs --max-bytes > 0", file=sys.stderr)
+            return EXIT_USAGE, []
+        evicted = evict_lru(ns.dir, ns.max_bytes)
+        out = {"evicted": evicted, "remaining_entries": len(scan(ns.dir)),
+               "remaining_bytes": total_bytes(ns.dir)}
+        if ns.json:
+            print(json.dumps(out, sort_keys=True, indent=1))
+        else:
+            for key in evicted:
+                print(f"evicted {key}")
+            print(kv_table({"evicted": len(evicted),
+                            "remaining_entries": out["remaining_entries"],
+                            "remaining_bytes": out["remaining_bytes"]}))
+        return EXIT_CLEAN, []
+    cache = AotCache(ns.dir)
+    if ns.stats:
+        stats = cache.stats()
+        del stats["max_bytes"]  # tool-side: no config context here
+        if ns.json:
+            print(json.dumps(stats, sort_keys=True, indent=1))
+        else:
+            print(kv_table(stats))
+        return EXIT_CLEAN, []
+    entries = cache.entries()
+    if ns.json:
+        print(json.dumps({"entries": entries}, sort_keys=True, indent=1))
+        return EXIT_CLEAN, []
+    if not entries:
+        print("(empty cache)")
+        return EXIT_CLEAN, []
+    for e in entries:
+        if "error" in e:
+            print(f"{e['key'][:16]}…  UNREADABLE({e['error']})  "
+                  f"{e['size']}B")
+        else:
+            print(f"{e['key'][:16]}…  {e['tag'] or '-'}  "
+                  f"payload={e['payload_len']}B  file={e['size']}B")
+    return EXIT_CLEAN, []
+
+
+def render(ns, findings, out):
+    """--verify report: the shared lint JSON document, or one text line
+    per finding (both byte-deterministic for a fixed cache)."""
+    from _common import emit_json_report
+
+    if ns.json:
+        emit_json_report(findings, out)
+        return
+    for f in findings:
+        out.write(f.text() + "\n")
+    if findings:
+        out.write(f"aotcache: {len(findings)} finding(s)\n")
+    else:
+        out.write("aotcache: cache verified clean\n")
+
+
+def main(argv=None) -> int:
+    return lint_main("aotcache", __doc__, build_arg_parser, collect,
+                     render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
