@@ -226,6 +226,59 @@ def low_precision_accumulation(program: "TracedProgram"):
                 break
 
 
+_QUANT_INT = ("int8", "uint8")
+_ACCUM_OK_INT = ("int32", "int64")
+_SUB_F32 = ("bfloat16", "float16")
+
+
+def _dtype_of(v) -> str | None:
+    dt = getattr(getattr(v, "aval", None), "dtype", None)
+    return None if dt is None else str(dt)
+
+
+@graph_rule("GRAPH407", "error",
+            "quantized op accumulating or dequantizing below contract")
+def quantized_accumulation(program: "TracedProgram"):
+    """The quantized execution modes (docs/quantization.md) carry two
+    dtype contracts the determinism argument leans on: a quantized
+    dot/conv must accumulate WIDE — int32 for int8 operands (the MXU's
+    exact integer accumulator; an int8 accumulator silently wraps),
+    float32 for fp8 operands (fp8/bf16 accumulation order visibly moves
+    the result, the GRAPH405 story one notch lower) — and dequantization
+    must pass through float32 with f32 scales: converting int8/fp8
+    directly to a sub-f32 float rounds TWICE (once at the convert, once
+    at the bf16 scale multiply), producing bits that depend on how the
+    backend fuses the pair."""
+    for idx, eqn in canonical_eqns(program.closed):
+        name = eqn.primitive.name
+        if name in ("dot_general", "conv_general_dilated"):
+            in_dts = [d for d in (_dtype_of(v) for v in eqn.invars)
+                      if d is not None]
+            out_dt = _dtype_of(eqn.outvars[0]) if eqn.outvars else None
+            if any(d in _QUANT_INT for d in in_dts):
+                if out_dt not in _ACCUM_OK_INT:
+                    yield idx, (f"`{name}` over int8 operands "
+                                f"accumulates in {out_dt} — quantized "
+                                "integer contractions must accumulate "
+                                "in int32 (preferred_element_type; "
+                                "docs/quantization.md)")
+            elif any(d is not None and d.startswith("float8")
+                     for d in in_dts):
+                if out_dt != "float32":
+                    yield idx, (f"`{name}` over fp8 operands "
+                                f"accumulates in {out_dt} — fp8 "
+                                "contractions must accumulate in "
+                                "float32 (docs/quantization.md)")
+        elif name == "convert_element_type":
+            src = _dtype_of(eqn.invars[0]) if eqn.invars else None
+            dst = _dtype_of(eqn.outvars[0]) if eqn.outvars else None
+            if src is not None and dst in _SUB_F32 and (
+                    src in _QUANT_INT or src.startswith("float8")):
+                yield idx, (f"convert {src} → {dst} — dequantization "
+                            "must pass through float32 (f32 scales, "
+                            "then cast down; docs/quantization.md)")
+
+
 _SEED_PRIMS = ("random_seed", "threefry_seed")
 
 

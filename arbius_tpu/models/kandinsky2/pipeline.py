@@ -84,9 +84,15 @@ class Kandinsky2Pipeline:
     MOVQ_FACTOR = 8
 
     def __init__(self, config: Kandinsky2Config | None = None, tokenizer=None,
-                 mesh=None):
+                 mesh=None, precision: str = "bf16"):
+        from arbius_tpu.quant import validate_mode
+
         self.config = config or Kandinsky2Config()
         self.mesh = mesh
+        # precision mode (docs/quantization.md): "bf16" is the historic
+        # program byte-for-byte; int8/fp8 take the factory-quantized
+        # weight tree and dequantize in-program — own golden per mode
+        self.precision = validate_mode(precision)
         if self.config.text.max_length < self.config.prior.text_len:
             raise ValueError(
                 f"text max_length ({self.config.text.max_length}) must be "
@@ -156,14 +162,18 @@ class Kandinsky2Pipeline:
                         steps: int, scheduler: str):
         return self._get_bucket(batch, height, width, steps, scheduler)[0]
 
-    @staticmethod
-    def bucket_tag(batch: int, height: int, width: int, steps: int,
+    def bucket_tag(self, batch: int, height: int, width: int, steps: int,
                    scheduler: str) -> str:
         """One definition of this family's executable-cache tag — the
         warm sets and the AOT disk-warm scan join on it
-        (docs/compile-cache.md)."""
+        (docs/compile-cache.md). Non-default precision modes suffix it
+        (".int8"/".fp8") so a quantized bucket never shares a warm
+        signal with its bf16 twin; bf16 tags stay byte-identical."""
+        from arbius_tpu.quant import mode_tag
+
         return "kandinsky2." + ".".join(
-            str(k) for k in (batch, height, width, steps, scheduler))
+            str(k) for k in (batch, height, width, steps, scheduler)) \
+            + mode_tag(self.precision)
 
     def _get_bucket(self, batch: int, height: int, width: int,
                     steps: int, scheduler: str, aot_args=None):
@@ -188,8 +198,15 @@ class Kandinsky2Pipeline:
         lat_shape = (batch, lh, lw, in_ch)
         text_len = cfg.prior.text_len
         eos_id = self.tokenizer.eos_id
+        precision = self.precision
 
         def run(params, ids, guidance, seeds_lo, seeds_hi):
+            if precision != "bf16":
+                from arbius_tpu.quant import dequantize_tree
+
+                # int8/fp8 kernels → f32 via their f32 scales (GRAPH407
+                # contract); guarded so bf16 stays byte-identical
+                params = dequantize_tree(params)
             states = self.text_encoder.apply({"params": params["text"]}, ids)
             # EOT pooling: hidden state at the first EOS position, then the
             # projection into embedding space (CLIP *WithProjection heads)
@@ -296,11 +313,14 @@ class Kandinsky2Pipeline:
             images = fn(params, *args)
         if self.mesh is not None:
             from arbius_tpu.parallel import meshsolve
+            from arbius_tpu.quant import storage_dtype
 
             meshsolve.record_bucket_estimate(
                 self._coll_est,
                 (batch, height, width, num_inference_steps, scheduler),
-                self.mesh, images, batch, params=params)
+                self.mesh, images, batch, params=params,
+                wire_dtype=storage_dtype(self.precision)
+                if self.precision != "bf16" else None)
         if as_device:
             # async-dispatch handle: the solver's chunk pipeline encodes
             # the previous chunk while the chip crunches this one
@@ -325,13 +345,18 @@ def trace_specs():
     from arbius_tpu.parallel import meshsolve
     from arbius_tpu.schedulers import sampler_tag
 
-    def build_bucket(axes=()):
+    def build_bucket(axes=(), precision="bf16"):
         def build():
+            from arbius_tpu.quant import abstract_quantized
+
             p = Kandinsky2Pipeline(Kandinsky2Config.tiny(),
-                                   mesh=meshsolve.golden_mesh(axes))
+                                   mesh=meshsolve.golden_mesh(axes),
+                                   precision=precision)
             batch = 2 if axes else 1
             shapes = jax.eval_shape(
                 lambda: p.init_params(height=64, width=64))
+            if precision != "bf16":
+                shapes = abstract_quantized(shapes, precision)
             sds = jax.ShapeDtypeStruct
             length = p.config.text.max_length
             args = (shapes, sds((batch, length), jnp.int32),
@@ -345,6 +370,11 @@ def trace_specs():
         TraceSpec(model="kandinsky2", entry="txt2img",
                   bucket=f"b1.64x64.{sampler_tag('DDIM', 2)}",
                   mesh="single", dtype="bfloat16", build=build_bucket()),
+        # quantized mode (docs/quantization.md): its own pinned class
+        TraceSpec(model="kandinsky2", entry="txt2img",
+                  bucket=f"b1.64x64.{sampler_tag('DDIM', 2)}",
+                  mesh="single", dtype="int8",
+                  build=build_bucket(precision="int8")),
     ] + [
         TraceSpec(model="kandinsky2", entry="txt2img",
                   bucket=f"b2.64x64.{sampler_tag('DDIM', 2)}",

@@ -57,9 +57,16 @@ class SD15Pipeline:
     VAE_FACTOR = 8
 
     def __init__(self, config: SD15Config | None = None, tokenizer=None,
-                 mesh=None):
+                 mesh=None, precision: str = "bf16"):
+        from arbius_tpu.quant import validate_mode
+
         self.config = config or SD15Config()
         self.mesh = mesh  # jax.sharding.Mesh with a 'dp' axis, or None
+        # precision mode (docs/quantization.md): "bf16" is THIS
+        # pipeline's historic program byte-for-byte; int8/fp8 expect
+        # checkpoint weights quantized at load (factory) and dequantize
+        # them inside the bucket program — each mode its own golden
+        self.precision = validate_mode(precision)
         if self.config.text.width != self.config.unet.context_dim:
             raise ValueError(
                 f"text encoder width ({self.config.text.width}) must equal "
@@ -162,15 +169,21 @@ class SD15Pipeline:
                    steps: int, scheduler: str):
         return self._get_bucket(batch, height, width, steps, scheduler)[0]
 
-    @staticmethod
-    def bucket_tag(batch: int, height: int, width: int, steps: int,
+    def bucket_tag(self, batch: int, height: int, width: int, steps: int,
                    scheduler: str) -> str:
         """The ONE definition of this family's executable-cache tag —
         the jit-cache warm set, the AOT cache's disk-warm scan, and the
         scheduler's cross-life warm boost all join on this string
-        (docs/compile-cache.md), so it may never be rebuilt ad hoc."""
+        (docs/compile-cache.md), so it may never be rebuilt ad hoc.
+        Non-default precision modes suffix the tag (".int8"/".fp8") —
+        a quantized bucket and its bf16 twin are different programs and
+        must never share a warm signal; bf16 tags are byte-identical to
+        the pre-quant node."""
+        from arbius_tpu.quant import mode_tag
+
         return "sd15." + ".".join(
-            str(k) for k in (batch, height, width, steps, scheduler))
+            str(k) for k in (batch, height, width, steps, scheduler)) \
+            + mode_tag(self.precision)
 
     def _get_bucket(self, batch: int, height: int, width: int,
                     steps: int, scheduler: str, aot_args=None):
@@ -194,8 +207,17 @@ class SD15Pipeline:
         sampler = get_sampler(scheduler, steps)
         lh, lw = height // self.VAE_FACTOR, width // self.VAE_FACTOR
         lat_shape = (batch, lh, lw, self.config.unet.in_channels)
+        precision = self.precision
 
         def run(params, ids_cond, ids_uncond, guidance, seeds_lo, seeds_hi):
+            if precision != "bf16":
+                from arbius_tpu.quant import dequantize_tree
+
+                # int8/fp8 kernels → f32 via their explicit f32 scales
+                # (GRAPH407 contract); the modules then cast to their
+                # bf16 compute dtype exactly as with f32 checkpoints.
+                # Guarded so the bf16 program stays byte-identical.
+                params = dequantize_tree(params)
             ctx_c = self.text_encoder.apply({"params": params["text"]}, ids_cond)
             ctx_u = self.text_encoder.apply({"params": params["text"]}, ids_uncond)
             context = jnp.concatenate([ctx_u, ctx_c], axis=0)  # [2B, L, D]
@@ -314,11 +336,14 @@ class SD15Pipeline:
             images = fn(params, *args)
         if self.mesh is not None:
             from arbius_tpu.parallel import meshsolve
+            from arbius_tpu.quant import storage_dtype
 
             meshsolve.record_bucket_estimate(
                 self._coll_est,
                 (batch, height, width, num_inference_steps, scheduler),
-                self.mesh, images, batch, params=params)
+                self.mesh, images, batch, params=params,
+                wire_dtype=storage_dtype(self.precision)
+                if self.precision != "bf16" else None)
         if as_device:
             return images
         return np.asarray(images)
@@ -345,19 +370,27 @@ def trace_specs():
     from arbius_tpu.parallel import meshsolve
     from arbius_tpu.schedulers import sampler_tag
 
-    def build_bucket(dtype: str, steps: int, scheduler: str, axes=()):
+    def build_bucket(dtype: str, steps: int, scheduler: str, axes=(),
+                     precision: str = "bf16"):
         def build():
+            from arbius_tpu.quant import abstract_quantized
+
             cfg = SD15Config.tiny()
             if dtype != "bfloat16":
                 cfg = SD15Config(
                     unet=dataclasses.replace(cfg.unet, dtype=dtype),
                     vae=dataclasses.replace(cfg.vae, dtype=dtype),
                     text=dataclasses.replace(cfg.text, dtype=dtype))
-            p = SD15Pipeline(cfg, mesh=meshsolve.golden_mesh(axes))
+            p = SD15Pipeline(cfg, mesh=meshsolve.golden_mesh(axes),
+                             precision=precision)
             batch = 2 if axes else 1
             lh = 64 // p.VAE_FACTOR
             shapes = jax.eval_shape(p._init_fn(lh, lh),
                                     jax.random.PRNGKey(0))
+            if precision != "bf16":
+                # the quantized checkpoint tree: int8/fp8 kernels with
+                # explicit f32 scales — what factory hands the runner
+                shapes = abstract_quantized(shapes, precision)
             sds = jax.ShapeDtypeStruct
             length = cfg.text.max_length
             args = (shapes,
@@ -370,6 +403,16 @@ def trace_specs():
         return build
 
     return [
+        # quantized modes (docs/quantization.md): the anythingv3 bucket
+        # with int8/fp8 checkpoint weights dequantized in-program — each
+        # mode a pinned determinism class, keyed like a compute dtype
+        TraceSpec(model="anythingv3", entry="txt2img",
+                  bucket=f"b1.64x64.{sampler_tag('DDIM', 2)}",
+                  mesh="single", dtype=mode,
+                  build=build_bucket("bfloat16", 2, "DDIM",
+                                     precision=mode))
+        for mode in ("int8", "fp8")
+    ] + [
         TraceSpec(model="anythingv3", entry="txt2img",
                   bucket=f"b1.64x64.{sampler_tag('DDIM', 2)}",
                   mesh="single", dtype=dtype,
@@ -386,4 +429,14 @@ def trace_specs():
                   mesh=meshsolve.golden_layout_tag(axes), dtype="bfloat16",
                   build=build_bucket("bfloat16", 2, "DDIM", axes))
         for axes in MESH_LAYOUTS
+    ] + [
+        # int8 × dp·tp: quantized kernels ride the tp rule table as
+        # 1-byte shards — the layout (wire-byte win) the quantized
+        # collective accounting meters (docs/quantization.md)
+        TraceSpec(model="anythingv3", entry="txt2img",
+                  bucket=f"b2.64x64.{sampler_tag('DDIM', 2)}",
+                  mesh=meshsolve.golden_layout_tag(("dp", "tp")),
+                  dtype="int8",
+                  build=build_bucket("bfloat16", 2, "DDIM", ("dp", "tp"),
+                                     "int8")),
     ]

@@ -60,9 +60,16 @@ class Text2VideoPipeline:
     VAE_FACTOR = 8
 
     def __init__(self, config: Text2VideoConfig | None = None, tokenizer=None,
-                 mesh=None):
+                 mesh=None, precision: str = "bf16"):
+        from arbius_tpu.quant import validate_mode
+
         self.config = config or Text2VideoConfig()
         self.mesh = mesh
+        # precision mode (docs/quantization.md): "bf16" is the historic
+        # program byte-for-byte; int8/fp8 take the factory-quantized
+        # UNet3D/temporal-conv weight tree (the ROADMAP's quantized
+        # hot loop) and dequantize in-program — own golden per mode
+        self.precision = validate_mode(precision)
         if self.config.text.width != self.config.unet.context_dim:
             raise ValueError(
                 f"text width ({self.config.text.width}) must equal unet "
@@ -128,15 +135,18 @@ class Text2VideoPipeline:
         return self._get_bucket(batch, frames, height, width, steps,
                                 scheduler)[0]
 
-    @staticmethod
-    def bucket_tag(batch: int, frames: int, height: int, width: int,
+    def bucket_tag(self, batch: int, frames: int, height: int, width: int,
                    steps: int, scheduler: str) -> str:
         """One definition of this family's executable-cache tag — the
         warm sets and the AOT disk-warm scan join on it
-        (docs/compile-cache.md)."""
+        (docs/compile-cache.md). Non-default precision modes suffix it
+        (".int8"/".fp8") — a quantized bucket never shares a warm
+        signal with its bf16 twin; bf16 tags stay byte-identical."""
+        from arbius_tpu.quant import mode_tag
+
         return "video." + ".".join(
             str(k) for k in (batch, frames, height, width, steps,
-                             scheduler))
+                             scheduler)) + mode_tag(self.precision)
 
     def _get_bucket(self, batch: int, frames: int, height: int,
                     width: int, steps: int, scheduler: str,
@@ -165,8 +175,15 @@ class Text2VideoPipeline:
         if batch % dp:
             raise ValueError(f"batch {batch} not divisible by dp={dp}")
         t_local = frames // sp
+        precision = self.precision
 
         def run(params, ids_c, ids_u, guidance, seeds_lo, seeds_hi):
+            if precision != "bf16":
+                from arbius_tpu.quant import dequantize_tree
+
+                # int8/fp8 kernels → f32 via their f32 scales (GRAPH407
+                # contract); guarded so bf16 stays byte-identical
+                params = dequantize_tree(params)
             b_local = ids_c.shape[0]
             if cfg.unet.sp_axis is not None:
                 sp_rank = jax.lax.axis_index(cfg.unet.sp_axis)
@@ -273,6 +290,9 @@ class Text2VideoPipeline:
 
             # params ride the shard_map replicated (in_spec P()), so the
             # traffic model is the dp/sp output-gather + halo terms only
+            # (out is uint8 already — no tp term exists for wire_dtype
+            # to quantize; a future tp-sharded video path would thread
+            # it like the image families do)
             meshsolve.record_bucket_estimate(
                 self._coll_est,
                 (batch, num_frames, height, width, num_inference_steps,
@@ -309,18 +329,26 @@ def trace_specs():
     from arbius_tpu.parallel import meshsolve
     from arbius_tpu.schedulers import sampler_tag
 
-    def build_single():
-        p = Text2VideoPipeline(Text2VideoConfig.tiny())
-        return _bucket_args(p, batch=1)
+    def build_single(precision="bf16"):
+        def build():
+            p = Text2VideoPipeline(Text2VideoConfig.tiny(),
+                                   precision=precision)
+            return _bucket_args(p, batch=1, precision=precision)
+
+        return build
 
     def build_sharded():
         p = Text2VideoPipeline(Text2VideoConfig.tiny(sp_axis="sp"),
                                mesh=meshsolve.golden_mesh(MESH_LAYOUTS[0]))
         return _bucket_args(p, batch=2)
 
-    def _bucket_args(p, batch):
+    def _bucket_args(p, batch, precision="bf16"):
         shapes = jax.eval_shape(
             lambda: p.init_params(frames=2, height=64, width=64))
+        if precision != "bf16":
+            from arbius_tpu.quant import abstract_quantized
+
+            shapes = abstract_quantized(shapes, precision)
         sds = jax.ShapeDtypeStruct
         length = p.config.text.max_length
         args = (shapes,
@@ -335,7 +363,11 @@ def trace_specs():
     return [
         TraceSpec(model="zeroscopev2xl", entry="txt2vid",
                   bucket=f"b1.{bucket}", mesh="single", dtype="bfloat16",
-                  build=build_single),
+                  build=build_single()),
+        # quantized UNet3D/temporal-conv mode (docs/quantization.md)
+        TraceSpec(model="zeroscopev2xl", entry="txt2vid",
+                  bucket=f"b1.{bucket}", mesh="single", dtype="int8",
+                  build=build_single("int8")),
         TraceSpec(model="zeroscopev2xl", entry="txt2vid",
                   bucket=f"b2.{bucket}", mesh=sharded_tag,
                   dtype="bfloat16", build=build_sharded),

@@ -145,6 +145,44 @@ class SchedConfig:
 
 
 @dataclass(frozen=True)
+class PrecisionConfig:
+    """Per-template precision modes (docs/quantization.md): `default`
+    applies to every enabled template, `templates` overrides per
+    template name. A mode is a DETERMINISM CLASS — `bf16` is the zoo's
+    byte-identical historic program; `int8`/`fp8` quantize checkpoint
+    weights at load (f32 dequant scales as explicit params) and run
+    mode-specific XLA programs with their own graphlint goldens, AOT
+    cache keys, and cost-model rows. A fleet mines ONE mode per
+    template, exactly like one mesh layout and one canonical batch —
+    miners advertise the mode, and the CID contract is per-mode, never
+    silently mixed."""
+    default: str = "bf16"
+    templates: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        from arbius_tpu.quant.modes import validate_mode
+
+        try:
+            validate_mode(self.default, where="precision.default")
+        except ValueError as e:
+            raise ConfigError(str(e)) from None
+        if not isinstance(self.templates, dict):
+            raise ConfigError(
+                "precision.templates must be a {template: mode} object "
+                '(e.g. {"anythingv3": "int8"})')
+        for tmpl, mode in self.templates.items():
+            try:
+                validate_mode(mode,
+                              where=f"precision.templates[{tmpl!r}]")
+            except ValueError as e:
+                raise ConfigError(str(e)) from None
+
+    def mode_for(self, template: str) -> str:
+        """The precision mode a template serves at."""
+        return self.templates.get(template, self.default)
+
+
+@dataclass(frozen=True)
 class AotCacheConfig:
     """Fleet-wide AOT executable cache (docs/compile-cache.md): persist
     compiled bucket executables on disk, keyed by the graphlint
@@ -377,6 +415,10 @@ class MiningConfig:
     # fleet-wide AOT executable cache (docs/compile-cache.md); default
     # OFF = memory-only bucket caching, compile on every boot
     aot_cache: AotCacheConfig = AotCacheConfig()
+    # per-template precision modes (docs/quantization.md); the default
+    # "bf16" everywhere IS the pre-quant node byte-for-byte — int8/fp8
+    # are opt-in per-template determinism classes
+    precision: PrecisionConfig = PrecisionConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -473,8 +515,11 @@ def load_config(raw: str | dict) -> MiningConfig:
     slo = build(SLOConfig, obj.pop("slo", {}), "slo")
     aot_cache = build(AotCacheConfig, obj.pop("aot_cache", {}),
                       "aot_cache")
+    precision = build(PrecisionConfig, obj.pop("precision", {}),
+                      "precision")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
                       ipfs=ipfs, pipeline=pipeline, sched=sched,
-                      fleet=fleet, slo=slo, aot_cache=aot_cache, **obj),
+                      fleet=fleet, slo=slo, aot_cache=aot_cache,
+                      precision=precision, **obj),
                  "config")

@@ -50,9 +50,10 @@ FIT_CAP = 64          # samples the median is taken over, post-subsample
 
 def bucket_str(key: tuple) -> str:
     """Canonical bucket-shape string for a node bucket key
-    `(model, width, height, steps, scheduler, num_frames)` — the shape
-    part only (model and layout ride separately in the cost tag)."""
-    _, w, h, steps, sched, frames = key
+    `(model, width, height, steps, scheduler, num_frames[, mode])` —
+    the shape part only (model, layout, and precision mode ride
+    separately in the cost tag)."""
+    w, h, steps, sched, frames = key[1:6]
 
     def s(v):
         return "-" if v is None else str(v)
@@ -60,29 +61,44 @@ def bucket_str(key: tuple) -> str:
     return f"{s(w)}x{s(h)}.s{s(steps)}.{s(sched)}.f{s(frames)}"
 
 
-def make_cost_tag(model: str, bucket: str, layout: str, n: int) -> str:
+def make_cost_tag(model: str, bucket: str, layout: str, n: int,
+                  mode: str = "bf16") -> str:
     """Tag attached to each `arbius_stage_seconds{infer}` observation:
     everything `ingest()` needs to turn the bucket's wall seconds into
-    per-task seconds under the right key. '|'-separated; none of the
-    fields can contain '|' (model ids are hex, bucket/layout are
+    per-task seconds under the right key — including the precision
+    mode (docs/quantization.md): an int8 bucket and its bf16 twin are
+    different programs with different chip-seconds, and their samples
+    must never blend into one row. '|'-separated; none of the fields
+    can contain '|' (model ids are hex, bucket/layout/mode are
     dot-joined alphanumerics)."""
-    return f"{model}|{bucket}|{layout}|n{n}"
+    return f"{model}|{bucket}|{layout}|{mode}|n{n}"
 
 
-def parse_cost_tag(tag) -> tuple[str, str, str, int] | None:
-    """Inverse of make_cost_tag; None for untagged/foreign samples."""
+def parse_cost_tag(tag) -> tuple[str, str, str, str, int] | None:
+    """Inverse of make_cost_tag → (model, bucket, layout, mode, n);
+    None for untagged/foreign samples. Pre-quant 4-field tags (no mode
+    — old snapshots, mixed-version fleets) parse as bf16: that is the
+    program they metered."""
+    from arbius_tpu.quant.modes import PRECISION_MODES
+
     if not isinstance(tag, str):
         return None
     parts = tag.split("|")
-    if len(parts) != 4 or not parts[3].startswith("n"):
+    if len(parts) == 4:
+        parts = parts[:3] + ["bf16", parts[3]]
+    if len(parts) != 5 or not parts[4].startswith("n"):
+        return None
+    if parts[3] not in PRECISION_MODES:
+        # foreign 5-field tag — never let an arbitrary string become a
+        # persisted cost-row mode key
         return None
     try:
-        n = int(parts[3][1:])
+        n = int(parts[4][1:])
     except ValueError:
         return None
     if n <= 0:
         return None
-    return parts[0], parts[1], parts[2], n
+    return parts[0], parts[1], parts[2], parts[3], n
 
 
 def seeded_fit(values: list[float], key: tuple) -> float:
@@ -110,17 +126,21 @@ def seeded_fit(values: list[float], key: tuple) -> float:
 @dataclass(frozen=True)
 class CostRow:
     """One fitted table entry: predicted chip-seconds per task for a
-    (model, bucket, layout) triple, and how many samples back it."""
+    (model, bucket, layout, mode) quadruple, and how many samples back
+    it. `mode` is the precision mode (docs/quantization.md): rows for
+    the same shape at different modes NEVER merge — they price
+    different XLA programs."""
     model: str
     bucket: str
     layout: str
     chip_seconds: float
     samples: int
     updated: int           # chain time of the last persist
+    mode: str = "bf16"
 
     def to_json(self) -> dict:
         return {"model": self.model, "bucket": self.bucket,
-                "layout": self.layout,
+                "layout": self.layout, "mode": self.mode,
                 "chip_seconds": round(self.chip_seconds, 6),
                 "samples": self.samples, "updated": self.updated}
 
@@ -145,8 +165,8 @@ class CostModel:
 
     # -- feeding ---------------------------------------------------------
     def observe(self, model: str, bucket: str, layout: str,
-                seconds_per_task: float) -> None:
-        key = (model, bucket, layout)
+                seconds_per_task: float, mode: str = "bf16") -> None:
+        key = (model, bucket, layout, mode)
         dq = self._samples.get(key)
         if dq is None:
             dq = self._samples[key] = deque(maxlen=SAMPLE_WINDOW)
@@ -161,8 +181,9 @@ class CostModel:
             parsed = parse_cost_tag(tag)
             if parsed is None:
                 continue
-            model, bucket, layout, tasks = parsed
-            self.observe(model, bucket, layout, float(value) / tasks)
+            model, bucket, layout, mode, tasks = parsed
+            self.observe(model, bucket, layout, float(value) / tasks,
+                         mode=mode)
             n += 1
         return n
 
@@ -203,15 +224,16 @@ class CostModel:
                 est = (p_est * w_old + est * w_new) / (w_old + w_new)
                 samples = p_n + count
             self.rows[key] = CostRow(
-                model=key[0], bucket=key[1], layout=key[2],
+                model=key[0], bucket=key[1], layout=key[2], mode=key[3],
                 chip_seconds=est, samples=samples, updated=int(now))
 
     # -- queries ---------------------------------------------------------
-    def predict(self, model: str, bucket: str,
-                layout: str) -> float | None:
+    def predict(self, model: str, bucket: str, layout: str,
+                mode: str = "bf16") -> float | None:
         """Per-task chip-seconds, or None until `min_samples` accrued
-        (caller falls back to the static config path)."""
-        row = self.rows.get((model, bucket, layout))
+        (caller falls back to the static config path). Keyed per
+        precision mode: an int8 row never answers for bf16."""
+        row = self.rows.get((model, bucket, layout, mode))
         if row is None or row.samples < self.min_samples:
             return None
         return row.chip_seconds
@@ -229,11 +251,12 @@ class CostModel:
         """Adopt the previous life's fitted rows: they predict
         immediately, and refits blend them with fresh evidence."""
         n = 0
-        for model, bucket, layout, chip_s, samples, updated in \
+        for model, bucket, layout, mode, chip_s, samples, updated in \
                 db.load_cost_rows():
-            key = (model, bucket, layout)
+            key = (model, bucket, layout, mode)
             self.rows[key] = CostRow(model=model, bucket=bucket,
-                                     layout=layout, chip_seconds=chip_s,
+                                     layout=layout, mode=mode,
+                                     chip_seconds=chip_s,
                                      samples=samples, updated=updated)
             self._prior[key] = (chip_s, samples)
             n += 1
@@ -243,5 +266,5 @@ class CostModel:
         rows = self.sorted_rows()
         if rows:
             db.upsert_cost_rows(
-                [(r.model, r.bucket, r.layout, r.chip_seconds,
+                [(r.model, r.bucket, r.layout, r.mode, r.chip_seconds,
                   r.samples, int(now)) for r in rows])

@@ -60,9 +60,9 @@ CREATE TABLE IF NOT EXISTS failed_jobs (
 CREATE TABLE IF NOT EXISTS pipeline_state (
     taskid TEXT PRIMARY KEY, stage TEXT, cid TEXT);
 CREATE TABLE IF NOT EXISTS cost_model (
-    model TEXT, bucket TEXT, layout TEXT,
+    model TEXT, bucket TEXT, layout TEXT, mode TEXT DEFAULT 'bf16',
     chip_seconds REAL, samples INT, updated INT,
-    PRIMARY KEY (model, bucket, layout));
+    PRIMARY KEY (model, bucket, layout, mode));
 CREATE INDEX IF NOT EXISTS jobs_priority ON jobs(priority);
 """
 
@@ -97,7 +97,39 @@ class NodeDB:
             # On :memory: the WAL pragma is a no-op — harmless.
             self._conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_ms)}")
             self._conn.execute("PRAGMA journal_mode=WAL")
+            self._migrate_cost_model()
             self._conn.executescript(_SCHEMA)
+
+    def _migrate_cost_model(self) -> None:
+        """Migrate a pre-quant `cost_model` table in place: the
+        precision mode joined the primary key (docs/quantization.md —
+        rows at different modes must coexist, so ALTER TABLE ADD COLUMN
+        is not enough), and every pre-quant row priced the bf16
+        programs, so the copy stamps mode='bf16'. Runs before the
+        schema script (CREATE IF NOT EXISTS would freeze the old
+        shape); a fresh or already-migrated file is a no-op. The
+        rename/copy/drop runs as ONE transaction (sqlite DDL is
+        transactional) — a crash mid-migration must roll back to the
+        old table, never strand the learned rows in a renamed husk."""
+        cols = [r[1] for r in self._conn.execute(
+            "PRAGMA table_info(cost_model)")]
+        if not cols or "mode" in cols:
+            return
+        self._conn.executescript("""
+            BEGIN;
+            ALTER TABLE cost_model RENAME TO cost_model_premode;
+            CREATE TABLE cost_model (
+                model TEXT, bucket TEXT, layout TEXT,
+                mode TEXT DEFAULT 'bf16',
+                chip_seconds REAL, samples INT, updated INT,
+                PRIMARY KEY (model, bucket, layout, mode));
+            INSERT INTO cost_model
+                SELECT model, bucket, layout, 'bf16',
+                       chip_seconds, samples, updated
+                FROM cost_model_premode;
+            DROP TABLE cost_model_premode;
+            COMMIT;
+        """)
 
     def _batch_depth(self) -> int:
         return getattr(self._batch, "depth", 0)
@@ -335,24 +367,26 @@ class NodeDB:
 
     # -- learned cost model (docs/scheduler.md) --------------------------
     def upsert_cost_rows(self, rows: list[tuple]) -> None:
-        """Persist fitted cost-model rows: (model, bucket, layout,
+        """Persist fitted cost-model rows: (model, bucket, layout, mode,
         chip_seconds, samples, updated). Written inside the tick's
         batch window, so refits cost no extra fsync."""
         with self._lock:
             self._conn.executemany(
                 "INSERT OR REPLACE INTO cost_model (model, bucket, layout,"
-                " chip_seconds, samples, updated) VALUES (?,?,?,?,?,?)",
+                " mode, chip_seconds, samples, updated)"
+                " VALUES (?,?,?,?,?,?,?)",
                 rows)
             self._commit()
 
     def load_cost_rows(self) -> list[tuple]:
-        """Every persisted (model, bucket, layout, chip_seconds,
+        """Every persisted (model, bucket, layout, mode, chip_seconds,
         samples, updated) row, deterministically ordered."""
         with self._lock:
             rows = self._conn.execute(
-                "SELECT model, bucket, layout, chip_seconds, samples,"
-                " updated FROM cost_model ORDER BY model, bucket, layout")
-            return [(r["model"], r["bucket"], r["layout"],
+                "SELECT model, bucket, layout, mode, chip_seconds,"
+                " samples, updated FROM cost_model"
+                " ORDER BY model, bucket, layout, mode")
+            return [(r["model"], r["bucket"], r["layout"], r["mode"],
                      float(r["chip_seconds"]), int(r["samples"]),
                      int(r["updated"])) for r in rows]
 

@@ -86,13 +86,16 @@ def _params_for(pipe, m: ModelConfig):
             # device first; storing checkpoints in the pinned dtype (the
             # documented config) avoids that hop entirely.
             params = pipe.place_params(params)
-        return params
+        return _maybe_quantize(pipe, m, params)
     log.warning("model %s: no checkpoint configured, using random init",
                 m.id)
     if mesh is not None and hasattr(pipe, "init_params_placed") \
-            and dtype is None:
+            and dtype is None \
+            and getattr(pipe, "precision", "bf16") == "bf16":
         # fused init + placement: one XLA program whose out_shardings
         # are the rule table's, so the unsharded tree never exists
+        # (quantized modes take the init→quantize→place path below —
+        # the quantized tree needs the quant-aware rule table)
         with compile_timer(f"boot.init.{m.template}"):
             return pipe.init_params_placed(seed=0)
     # dtype folds the cast into the init program: a separate cast program
@@ -100,7 +103,29 @@ def _params_for(pipe, m: ModelConfig):
     # tree) and OOMs a 16 GB chip; fused, each f32 leaf dies at its cast
     with compile_timer(f"boot.init.{m.template}"):
         params = pipe.init_params(seed=0, dtype=dtype)
+    params = _maybe_quantize(pipe, m, params, placed=False)
     return pipe.place_params(params) if mesh is not None else params
+
+
+def _maybe_quantize(pipe, m: ModelConfig, params, *, placed: bool = True):
+    """Quantize the weight tree ONCE at load when the pipeline serves a
+    quantized precision mode (docs/quantization.md): one jitted program
+    (no donation — an int8 output can never alias its f32 source; XLA
+    frees each full-width leaf at its last read inside the program),
+    then re-placement through the quant-aware rule table when a mesh is
+    up, so int8/fp8 kernels keep their tp split as 1-byte shards and
+    the per-channel f32 scales split with them."""
+    mode = getattr(pipe, "precision", "bf16")
+    if mode == "bf16":
+        return params
+    from arbius_tpu.obs import compile_timer as _ct
+    from arbius_tpu.quant import quantize_params
+
+    with _ct(f"boot.quant.{m.template}"):
+        params = quantize_params(params, mode)
+    if placed and getattr(pipe, "mesh", None) is not None:
+        params = pipe.place_params(params)
+    return params
 
 
 def _tokenizer_for(m: ModelConfig, text_cfg):
@@ -118,11 +143,12 @@ def _tokenizer_for(m: ModelConfig, text_cfg):
     return tiny_byte_tokenizer(text_cfg) if m.tiny else None
 
 
-def _sd15(m: ModelConfig, mesh):
+def _sd15(m: ModelConfig, mesh, mode: str = "bf16"):
     from arbius_tpu.models.sd15 import SD15Config, SD15Pipeline
 
     cfg = SD15Config.tiny() if m.tiny else SD15Config()
-    pipe = SD15Pipeline(cfg, tokenizer=_tokenizer_for(m, cfg.text), mesh=mesh)
+    pipe = SD15Pipeline(cfg, tokenizer=_tokenizer_for(m, cfg.text), mesh=mesh,
+                        precision=mode)
     return SD15Runner(pipe, _params_for(pipe, m))
 
 
@@ -135,16 +161,16 @@ def tiny_byte_tokenizer(text_cfg):
                          bos_id=257, eos_id=258)
 
 
-def _kandinsky2(m: ModelConfig, mesh):
+def _kandinsky2(m: ModelConfig, mesh, mode: str = "bf16"):
     from arbius_tpu.models.kandinsky2 import Kandinsky2Config, Kandinsky2Pipeline
 
     cfg = Kandinsky2Config.tiny() if m.tiny else Kandinsky2Config()
     pipe = Kandinsky2Pipeline(cfg, tokenizer=_tokenizer_for(m, cfg.text),
-                              mesh=mesh)
+                              mesh=mesh, precision=mode)
     return Kandinsky2Runner(pipe, _params_for(pipe, m))
 
 
-def _video(m: ModelConfig, mesh):
+def _video(m: ModelConfig, mesh, mode: str = "bf16"):
     from arbius_tpu.models.video import (
         Text2VideoConfig,
         Text2VideoPipeline,
@@ -175,7 +201,7 @@ def _video(m: ModelConfig, mesh):
                 f"head counts {bad} — use sp_strategy='ring' (works for "
                 "any head count) or a different sp width")
     pipe = Text2VideoPipeline(cfg, tokenizer=_tokenizer_for(m, cfg.text),
-                              mesh=mesh)
+                              mesh=mesh, precision=mode)
     return Text2VideoRunner(pipe, _params_for(pipe, m))
 
 
@@ -281,7 +307,16 @@ def build_registry(cfg: MiningConfig, *, mesh=None,
     for m in cfg.models:
         if not m.enabled:
             continue
+        mode = cfg.precision.mode_for(m.template)
         if m.template == "robust_video_matting":
+            if mode != "bf16":
+                # boot error, mesh-style: the stateful ConvGRU matting
+                # stream ships no quantized goldens, so a quantized
+                # mode here would mine a determinism class nothing pins
+                raise ConfigError(
+                    f"precision mode {mode!r} is not shipped for "
+                    "template robust_video_matting — the matting "
+                    "family serves bf16 only (docs/quantization.md)")
             if resolve_file is None and not (m.golden or {}).get("probe_video"):
                 log.warning("model %s: robust_video_matting needs a "
                             "resolve_file (or a probe_video golden); "
@@ -289,7 +324,7 @@ def build_registry(cfg: MiningConfig, *, mesh=None,
                 continue
             runner = _rvm(m, mesh, resolve_file)
         elif m.template in _BUILDERS:
-            runner = _BUILDERS[m.template](m, mesh)
+            runner = _BUILDERS[m.template](m, mesh, mode)
         else:
             log.warning("model %s: unknown template %r; skipping",
                         m.id, m.template)

@@ -176,6 +176,12 @@ class MinerNode:
         # programs with different chip-seconds); boot() refines it once
         # the mesh is up
         self.solve_layout = "single"
+        # per-model precision modes (docs/quantization.md): fixed at
+        # config load — part of every bucket key and cost tag, so an
+        # int8 bucket never shares a dispatch, a cost row, or a warm
+        # signal with its bf16 twin
+        self.solve_modes = {m.id.lower(): config.precision.mode_for(m.template)
+                            for m in config.models}
         # learned chip-seconds table (docs/scheduler.md): always
         # constructed — the gate consults it whenever rows have accrued,
         # and with an empty table every prediction is None, so the gate
@@ -233,6 +239,19 @@ class MinerNode:
 
         self.mesh = meshsolve.boot_mesh(self.config.mesh,
                                         registry=self.obs.registry)
+        # fleet-composition surface (docs/quantization.md): how many
+        # enabled models this node serves at each precision mode — the
+        # signal a mixed-precision fleet's pricing/packing reads
+        modes_gauge = self.obs.registry.gauge(
+            "arbius_precision_models",
+            "Enabled models served at each precision mode (bf16 = the "
+            "historic full-width programs; docs/quantization.md)",
+            labelnames=("mode",))
+        for mode in sorted({"bf16"} | set(self.solve_modes.values())):
+            modes_gauge.set(float(sum(
+                1 for m in self.config.models if m.enabled
+                and self.solve_modes.get(m.id.lower()) == mode)),
+                mode=mode)
         if self.mesh is not None:
             from arbius_tpu.parallel.mesh import mesh_tag
 
@@ -659,16 +678,18 @@ class MinerNode:
         est = None
         source = "static"
         if sched_on and model_id is not None:
+            mode = self.solve_mode(model_id)
             if hydrated is not None:
-                key = bucket_key(model_id, hydrated)
+                key = bucket_key(model_id, hydrated, mode)
                 est = self.costmodel.predict(model_id, bucket_str(key),
-                                             self.solve_layout)
+                                             self.solve_layout, mode)
                 if est is not None:
                     source = "cost_model"
             else:
                 learned = [
                     r.chip_seconds for r in self.costmodel.rows.values()
                     if r.model == model_id and r.layout == self.solve_layout
+                    and r.mode == mode
                     and r.samples >= self.costmodel.min_samples]
                 if learned:
                     static = self._static_solve_seconds()
@@ -686,6 +707,11 @@ class MinerNode:
                            cost_floor=str(floor), source=source,
                            verdict="accept" if ok else "reject")
         return ok
+
+    def solve_mode(self, model_id: str) -> str:
+        """The precision mode this node serves a model at
+        (docs/quantization.md) — bf16 for anything unconfigured."""
+        return self.solve_modes.get(model_id.lower(), "bf16")
 
     def bucket_disk_warm(self, key: tuple, entries: list) -> bool:
         """Cross-life warm signal for the packer (docs/compile-cache.md):
@@ -745,7 +771,8 @@ class MinerNode:
                 self._fail_job(job, ValueError("no stored task input"))
                 continue
             by_bucket.setdefault(
-                bucket_key(job.data["model"], hydrated), []).append(
+                bucket_key(job.data["model"], hydrated,
+                           self.solve_mode(job.data["model"])), []).append(
                 (job, hydrated))
         # fee SELECTs stay OUTSIDE the state lock (per-task sqlite I/O
         # must not stall the RPC debug views or the device stage's
@@ -781,8 +808,10 @@ class MinerNode:
 
     def _cost_tag(self, key: tuple, n: int) -> str:
         from arbius_tpu.node.costmodel import bucket_str, make_cost_tag
+        from arbius_tpu.node.solver import bucket_mode
 
-        return make_cost_tag(key[0], bucket_str(key), self.solve_layout, n)
+        return make_cost_tag(key[0], bucket_str(key), self.solve_layout, n,
+                             mode=bucket_mode(key))
 
     def _solve_bucket(self, m, entries: list[tuple[Job, dict]],
                       key: tuple) -> int:

@@ -600,6 +600,11 @@ class ControlRPC:
                     # is disabled
                     "aot_disk_warm": sorted(self.node._disk_warm_tags),
                     "layout": self.node.solve_layout,
+                    # per-model precision modes (docs/quantization.md):
+                    # every cost row above is keyed per mode, and this
+                    # is the mode table the node buckets/prices with
+                    "modes": {mid: self.node.solve_modes[mid]
+                              for mid in sorted(self.node.solve_modes)},
                     "min_fee_per_second": str(cfg.min_fee_per_second),
                     "static_seconds": self.node._static_solve_seconds(),
                 }

@@ -106,9 +106,14 @@ class CostSched(FifoSched):
         static p50 is of whole-BUCKET dispatch walls (stage=infer is
         observed once per bucket), so it is already a bucket cost:
         multiplying it by n_tasks would double-scale cold buckets
-        against learned ones whenever history ran multi-task buckets."""
+        against learned ones whenever history ran multi-task buckets.
+        The bucket key carries its precision mode (solver.bucket_key),
+        so an int8 bucket prices from int8 rows only."""
+        from arbius_tpu.node.solver import bucket_mode
+
         per_task = self.node.costmodel.predict(
-            key[0], bucket_str(key), self.node.solve_layout)
+            key[0], bucket_str(key), self.node.solve_layout,
+            bucket_mode(key))
         if per_task is not None:
             return per_task * n_tasks, "cost_model"
         return self.node._static_solve_seconds(), "static"

@@ -62,17 +62,26 @@ class ModelRegistry:
         return list(self._models)
 
 
-def bucket_key(model_id: str, hydrated: dict) -> tuple:
+def bucket_key(model_id: str, hydrated: dict, mode: str = "bf16") -> tuple:
     """The shape-bucket identity of one task: every field that is part
     of the compiled XLA program (w/h/steps/scheduler, and num_frames
-    for video templates — image templates simply carry None there).
-    Tasks sharing a key run as ONE batched dispatch; the key is also
-    the cost model's bucket feature and the packer's unit of
-    reordering (node/sched.py, docs/scheduler.md), so it lives here —
-    next to the chunking it must agree with — not in the node."""
+    for video templates — image templates simply carry None there),
+    plus the PRECISION MODE (docs/quantization.md) — a quantized bucket
+    and its bf16 twin are different XLA programs, so they are different
+    buckets exactly like different shapes. Tasks sharing a key run as
+    ONE batched dispatch; the key is also the cost model's bucket
+    feature and the packer's unit of reordering (node/sched.py,
+    docs/scheduler.md), so it lives here — next to the chunking it must
+    agree with — not in the node."""
     return (model_id, hydrated.get("width"), hydrated.get("height"),
             hydrated.get("num_inference_steps"),
-            hydrated.get("scheduler"), hydrated.get("num_frames"))
+            hydrated.get("scheduler"), hydrated.get("num_frames"), mode)
+
+
+def bucket_mode(key: tuple) -> str:
+    """The precision mode a bucket key carries (pre-quant 6-tuples read
+    as bf16, so persisted/legacy keys keep meaning what they meant)."""
+    return key[6] if len(key) > 6 else "bf16"
 
 
 def _check_declared(model: RegisteredModel, files: dict) -> dict:

@@ -222,7 +222,8 @@ def gather_canonical(out) -> np.ndarray:
 # -- collective-traffic accounting ------------------------------------------
 
 def estimate_collective_bytes(mesh, out_shape, out_dtype, params=None,
-                              *, batch_sharded: bool = True) -> dict[str, int]:
+                              *, batch_sharded: bool = True,
+                              wire_dtype=None) -> dict[str, int]:
     """Per-dispatch cross-chip traffic estimate, by mesh axis.
 
     A compile-time byte-count model (the obs satellite's contract —
@@ -242,6 +243,13 @@ def estimate_collective_bytes(mesh, out_shape, out_dtype, params=None,
           (exactly computable from the param tree at placement time,
           and of the same order as the activation at canonical batch).
 
+    `wire_dtype` overrides the per-ELEMENT width of the tp allreduce
+    term: when the tp path runs an EQuARX-style quantized collective
+    (docs/quantization.md) the slab moves as 1-byte elements regardless
+    of the leaf dtype, and `arbius_collective_bytes_total{axis="tp"}`
+    must report the actual wire bytes, not the full-width assumption.
+    None (the default) keeps the historic leaf-dtype-width model.
+
     Axes of size 1 contribute nothing. Returns {axis: bytes}."""
     est: dict[str, int] = {}
     if mesh is None:
@@ -256,6 +264,8 @@ def estimate_collective_bytes(mesh, out_shape, out_dtype, params=None,
     if tp > 1 and params is not None:
         import jax
 
+        wire_width = np.dtype(wire_dtype).itemsize \
+            if wire_dtype is not None else None
         sharded = 0
         for leaf in jax.tree_util.tree_leaves(params):
             sh = getattr(leaf, "sharding", None)
@@ -263,7 +273,9 @@ def estimate_collective_bytes(mesh, out_shape, out_dtype, params=None,
             if spec is not None and any(
                     s == "tp" or (isinstance(s, tuple) and "tp" in s)
                     for s in spec):
-                sharded += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+                width = wire_width if wire_width is not None \
+                    else leaf.dtype.itemsize
+                sharded += int(np.prod(leaf.shape)) * width
         if sharded:
             # ring allreduce moves 2·(tp-1)/tp of the slab per collective
             est["tp"] = 2 * sharded * (tp - 1) // tp
@@ -271,7 +283,7 @@ def estimate_collective_bytes(mesh, out_shape, out_dtype, params=None,
 
 
 def record_bucket_estimate(cache: dict, bucket_key, mesh, out, batch: int,
-                           *, params=None) -> None:
+                           *, params=None, wire_dtype=None) -> None:
     """Record one dispatch's traffic, estimating at most once per bucket:
     the estimate is pure in (mesh, bucket shape, param placement), so the
     first dispatch of a bucket walks the param tree and later dispatches
@@ -279,14 +291,16 @@ def record_bucket_estimate(cache: dict, bucket_key, mesh, out, batch: int,
     hundreds of leaves to recompute a constant. `batch_sharded` comes
     from the same `batch_specs` decision the bucket compiled with, so a
     replicated-degrade bucket is not charged dp/sp gathers that never
-    cross chips."""
+    cross chips. `wire_dtype` rides through to the tp term for
+    quantized-collective buckets (see estimate_collective_bytes)."""
     if mesh is None:
         return
     est = cache.get(bucket_key)
     if est is None:
         _, sharded = batch_specs(mesh, batch)
         est = estimate_collective_bytes(mesh, out.shape, out.dtype,
-                                        params=params, batch_sharded=sharded)
+                                        params=params, batch_sharded=sharded,
+                                        wire_dtype=wire_dtype)
         cache[bucket_key] = est
     record_collective_bytes(est)
 
@@ -332,11 +346,16 @@ def _probe_params(dim: int = _PROBE_DIM) -> np.ndarray:
 class _ProbeBase:
     """Shared probe surface: canonical-batch Runner protocol over a
     jitted sharded program. `gate` (e.g. simnet's plane.runner_gate) is
-    called once per dispatch so fault injection composes."""
+    called once per dispatch so fault injection composes. `mode` is the
+    precision mode (docs/quantization.md): "bf16" is the exact historic
+    probe program (goldens unchanged); int8/fp8 quantize the probe
+    weights and dequantize inside the jit — a different program, its
+    own golden, deterministic in (input, seed, layout, mode)."""
 
     mesh: object = None
     out_name: str = "out-1.png"
     gate: object = None
+    mode: str = "bf16"
 
     def __call__(self, hydrated: dict, seed: int) -> dict:
         return self.finalize(self.dispatch([(hydrated, seed)]), 1)[0]
@@ -366,8 +385,12 @@ class ShardedImageProbe(_ProbeBase):
     reduction chip-local (the tp collective is concatenation-only), so
     the bytes are exactly layout-invariant."""
 
-    def __init__(self, mesh=None, out_name: str = "out-1.png", gate=None):
-        super().__init__(mesh=mesh, out_name=out_name, gate=gate)
+    def __init__(self, mesh=None, out_name: str = "out-1.png", gate=None,
+                 mode: str = "bf16"):
+        from arbius_tpu.quant import validate_mode
+
+        super().__init__(mesh=mesh, out_name=out_name, gate=gate,
+                         mode=validate_mode(mode))
         self._fns: dict[int, object] = {}
         self._est: dict[int, dict] = {}
         self._params = None
@@ -376,17 +399,23 @@ class ShardedImageProbe(_ProbeBase):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         tp = self.mesh.shape.get("tp", 1)
-        if tp > 1 and _PROBE_DIM % tp == 0:
-            # column-parallel over tp: concat-gather, no psum
-            return NamedSharding(self.mesh, P(None, "tp"))
-        return NamedSharding(self.mesh, P())
+        col = tp > 1 and _PROBE_DIM % tp == 0
+        # column-parallel over tp when it divides: concat-gather, no psum
+        kernel = NamedSharding(self.mesh, P(None, "tp") if col else P())
+        if self.mode == "bf16":
+            return kernel
+        # quantized tree: int8/fp8 kernel keeps the column split, the
+        # per-output-channel f32 scale shards over the same tp axis
+        scale = NamedSharding(self.mesh, P("tp") if col else P())
+        return {"qs": scale, "qv": kernel}
 
     def _fn(self, batch: int):
         return self._get_fn(batch)[0]
 
-    @staticmethod
-    def bucket_tag(batch: int) -> str:
-        return f"meshprobe.img.b{batch}"
+    def bucket_tag(self, batch: int) -> str:
+        from arbius_tpu.quant import mode_tag
+
+        return f"meshprobe.img.b{batch}" + mode_tag(self.mode)
 
     def cache_tag(self, hydrated: dict, batch: int) -> str:
         """The tag a dispatch of this bucket would cache under — the
@@ -412,7 +441,17 @@ class ShardedImageProbe(_ProbeBase):
         import jax
         import jax.numpy as jnp
 
+        mode = self.mode
+
         def run(params, seeds):
+            if mode != "bf16":
+                from arbius_tpu.quant import dequantize_leaf
+
+                # int8/fp8 kernel → f32 via the f32-scale dequant
+                # (GRAPH407 contract); the bf16 program below stays
+                # byte-identical to the pre-quant probe
+                params = dequantize_leaf(params)
+
             def per(k):
                 key = jax.random.PRNGKey(k)
                 noise = jax.random.normal(key, (_PROBE_DIM, _PROBE_DIM),
@@ -428,6 +467,14 @@ class ShardedImageProbe(_ProbeBase):
                        in_shardings=(self._param_sharding(), spec(1)),
                        out_shardings=spec(3))
 
+    def _wire_dtype(self):
+        """Quantized modes move 1-byte elements on the tp wire — the
+        collective-byte model reports actual wire width
+        (docs/quantization.md wire-byte accounting)."""
+        from arbius_tpu.quant import storage_dtype
+
+        return storage_dtype(self.mode) if self.mode != "bf16" else None
+
     def dispatch(self, items: list):
         if self.gate is not None:
             self.gate()
@@ -437,6 +484,10 @@ class ShardedImageProbe(_ProbeBase):
 
         if self._params is None:
             raw = _probe_params()
+            if self.mode != "bf16":
+                from arbius_tpu.quant import quantize_leaf
+
+                raw = quantize_leaf(raw, self.mode)
             self._params = jax.device_put(
                 raw, self._param_sharding()) if self.mesh is not None \
                 else jax.device_put(raw)
@@ -447,7 +498,8 @@ class ShardedImageProbe(_ProbeBase):
         with timed_dispatch(warm, tag):
             out = fn(self._params, seeds_dev)
         record_bucket_estimate(self._est, len(items), self.mesh, out,
-                               len(items), params=self._params)
+                               len(items), params=self._params,
+                               wire_dtype=self._wire_dtype())
         return out
 
 
@@ -461,8 +513,11 @@ class ShardedSeqProbe(_ProbeBase):
     frames: int = 4
 
     def __init__(self, mesh=None, out_name: str = "out-1.png", gate=None,
-                 frames: int = 4):
-        super().__init__(mesh=mesh, out_name=out_name, gate=gate)
+                 frames: int = 4, mode: str = "bf16"):
+        from arbius_tpu.quant import validate_mode
+
+        super().__init__(mesh=mesh, out_name=out_name, gate=gate,
+                         mode=validate_mode(mode))
         self.frames = frames
         self._fns: dict[int, object] = {}
         self._est: dict[int, dict] = {}
@@ -472,7 +527,10 @@ class ShardedSeqProbe(_ProbeBase):
         return self._get_fn(batch)[0]
 
     def bucket_tag(self, batch: int) -> str:
-        return f"meshprobe.seq.b{batch}.f{self.frames}"
+        from arbius_tpu.quant import mode_tag
+
+        return f"meshprobe.seq.b{batch}.f{self.frames}" \
+            + mode_tag(self.mode)
 
     def cache_tag(self, hydrated: dict, batch: int) -> str:
         """Scheduler's cross-life disk-warm join key
@@ -491,7 +549,7 @@ class ShardedSeqProbe(_ProbeBase):
             mesh = self.mesh
             if mesh is not None and batch % mesh.shape.get("dp", 1):
                 mesh = None
-            return build_seq_probe_fn(mesh, self.frames)
+            return build_seq_probe_fn(mesh, self.frames, mode=self.mode)
 
         return jit_cache_get(self._fns, batch, build,
                              tag=self.bucket_tag(batch),
@@ -505,7 +563,12 @@ class ShardedSeqProbe(_ProbeBase):
         from arbius_tpu.obs import timed_dispatch
 
         if self._params is None:
-            self._params = jax.device_put(_probe_params())
+            raw = _probe_params()
+            if self.mode != "bf16":
+                from arbius_tpu.quant import quantize_leaf
+
+                raw = quantize_leaf(raw, self.mode)
+            self._params = jax.device_put(raw)
         seeds = self._seeds(items)
         (seeds_dev,) = shard_batch(self.mesh, seeds)
         fn, warm, tag = self._get_fn(
@@ -517,13 +580,22 @@ class ShardedSeqProbe(_ProbeBase):
         return out
 
 
-def build_seq_probe_fn(mesh, frames: int, *, psum_axes=("sp",)):
+def build_seq_probe_fn(mesh, frames: int, *, psum_axes=("sp",),
+                       mode: str = "bf16"):
     """The seq probe's jitted program, exposed for graphlint: a
     shard_map over (dp, sp) whose temporal stream is keyed by global
     frame index and whose one cross-shard reduction is an int32 psum
     over `psum_axes` (canonical single-axis order — GRAPH403's beat).
     `psum_axes` is parameterizable so the rule test can trace the same
-    program with a deliberately non-canonical multi-axis reduction."""
+    program with a deliberately non-canonical multi-axis reduction.
+
+    `mode` != "bf16" is the quantized determinism class
+    (docs/quantization.md): params arrive as the quantized {"qs","qv"}
+    tree and dequantize in-program, and — when frames shard over sp —
+    a cross-shard temporal summary travels through the EQuARX-style
+    `quantized_ring_allreduce`, putting a real quantized collective in
+    the shipped program the per-mode golden pins. The default is the
+    byte-identical pre-quant program."""
     import jax
     import jax.numpy as jnp
 
@@ -533,6 +605,10 @@ def build_seq_probe_fn(mesh, frames: int, *, psum_axes=("sp",)):
     t_local = frames // sp
 
     def run(params, seeds):
+        if mode != "bf16":
+            from arbius_tpu.quant import dequantize_leaf
+
+            params = dequantize_leaf(params)
         if sp > 1:
             frame0 = jax.lax.axis_index("sp") * t_local
         else:
@@ -545,6 +621,18 @@ def build_seq_probe_fn(mesh, frames: int, *, psum_axes=("sp",)):
                 jnp.float32) @ params))(frame0 + jnp.arange(t_local))
 
         x = jax.vmap(per)(seeds)
+        if mode != "bf16" and sp > 1:
+            from arbius_tpu.parallel.collectives import \
+                quantized_ring_allreduce
+
+            # fold a cross-shard temporal mean through the quantized
+            # collective: the 1-byte wire is where the tp/sp byte
+            # savings come from, and the ring schedule is fixed per
+            # layout, so the fold is deterministic — this (layout,
+            # mode) program is its own golden-pinned class
+            m = quantized_ring_allreduce(jnp.mean(x, axis=1), "sp",
+                                         mode=mode)
+            x = x + m[:, None] * (1.0 / 16.0)
         # integer frame checksum summed across every shard: exact in any
         # reduction order, so the psum cannot move bytes across layouts
         check = jnp.sum((x * 255.0).astype(jnp.int32) & 0xFF,
@@ -584,29 +672,36 @@ def trace_specs():
     import jax.numpy as jnp
 
     from arbius_tpu.models.trace_specs import TraceSpec
+    from arbius_tpu.quant import abstract_quantized
 
     sds = jax.ShapeDtypeStruct
 
-    def build_img(axes):
+    def param_args(batch: int, mode: str):
+        p = sds((_PROBE_DIM, _PROBE_DIM), jnp.float32)
+        if mode != "bf16":
+            p = abstract_quantized(p, mode)
+        return (p, sds((batch,), jnp.uint32))
+
+    def build_img(axes, mode="bf16"):
         def build():
-            probe = ShardedImageProbe(mesh=golden_mesh(axes))
+            probe = ShardedImageProbe(mesh=golden_mesh(axes), mode=mode)
             batch = 2 if axes else 1
-            args = (sds((_PROBE_DIM, _PROBE_DIM), jnp.float32),
-                    sds((batch,), jnp.uint32))
-            return probe._fn(batch), args
+            return probe._fn(batch), param_args(batch, mode)
 
         return build
 
-    def build_seq(axes):
+    def build_seq(axes, mode="bf16"):
         def build():
-            fn = build_seq_probe_fn(golden_mesh(axes), frames=4)
+            fn = build_seq_probe_fn(golden_mesh(axes), frames=4,
+                                    mode=mode)
             batch = 2 if axes else 1
-            args = (sds((_PROBE_DIM, _PROBE_DIM), jnp.float32),
-                    sds((batch,), jnp.uint32))
-            return fn, args
+            return fn, param_args(batch, mode)
 
         return build
 
+    # bf16 keys carry dtype="float32" (the probes' historic compute
+    # dtype tag — goldens unchanged); quantized modes key on the mode,
+    # exactly like the model families (docs/quantization.md)
     return [
         TraceSpec(model="meshprobe", entry="img",
                   bucket="b2" if axes else "b1", mesh=golden_layout_tag(axes),
@@ -617,5 +712,16 @@ def trace_specs():
                   bucket="b2.f4" if axes else "b1.f4",
                   mesh=golden_layout_tag(axes), dtype="float32",
                   build=build_seq(axes))
+        for axes in SEQ_LAYOUTS
+    ] + [
+        TraceSpec(model="meshprobe", entry="img",
+                  bucket="b2" if axes else "b1", mesh=golden_layout_tag(axes),
+                  dtype="int8", build=build_img(axes, "int8"))
+        for axes in IMG_LAYOUTS
+    ] + [
+        TraceSpec(model="meshprobe", entry="seq",
+                  bucket="b2.f4" if axes else "b1.f4",
+                  mesh=golden_layout_tag(axes), dtype="int8",
+                  build=build_seq(axes, "int8"))
         for axes in SEQ_LAYOUTS
     ]

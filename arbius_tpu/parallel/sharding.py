@@ -73,8 +73,20 @@ def sharding_tree(
 
     def pick(path, leaf):
         name = _path_str(path)
+        # quantized kernels (docs/quantization.md) nest the rule-matched
+        # leaf one level down: `.../kernel/qv` is the int8/fp8 kernel
+        # (rules apply unchanged — same shape as the full-width kernel)
+        # and `.../kernel/qs` the per-OUTPUT-channel f32 scale, which
+        # follows the kernel's LAST spec axis (a column-split kernel
+        # splits its scales with it; an input-split one replicates them)
+        quant_part = None
+        if name.endswith("/qv") or name.endswith("/qs"):
+            quant_part = name[-2:]
+            name = name[: -3]
         for pat, spec in compiled:
             if pat.match(name):
+                if quant_part == "qs":
+                    spec = P(spec[-1] if len(spec) else None)
                 # replicate when the rule doesn't apply to this leaf: rank
                 # mismatch (a conv rule matching a dense kernel) or an axis
                 # the leaf can't divide (e.g. tiny test configs)

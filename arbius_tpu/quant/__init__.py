@@ -1,0 +1,36 @@
+"""arbius_tpu.quant — int8/fp8 execution modes under the determinism gate.
+
+Weight quantization at checkpoint-load, f32 dequant scales as explicit
+params, per-mode program identities (docs/quantization.md). The mode
+registry (`modes`) is jax-free for config/CLI use; the math (`core`)
+imports jax lazily.
+"""
+from arbius_tpu.quant.modes import (
+    DEFAULT_MODE,
+    FP8_BOUND,
+    INT8_BOUND,
+    PRECISION_MODES,
+    mode_tag,
+    validate_mode,
+    wire_width,
+)
+from arbius_tpu.quant.core import (
+    QUANT_KEYS,
+    abstract_quantized,
+    dequantize_leaf,
+    dequantize_tree,
+    is_quantized_leaf,
+    quantize_leaf,
+    quantize_params,
+    quantize_tree,
+    quantized_dot,
+    storage_dtype,
+)
+
+__all__ = [
+    "DEFAULT_MODE", "FP8_BOUND", "INT8_BOUND", "PRECISION_MODES",
+    "QUANT_KEYS", "abstract_quantized", "dequantize_leaf",
+    "dequantize_tree", "is_quantized_leaf", "mode_tag", "quantize_leaf",
+    "quantize_params", "quantize_tree", "quantized_dot", "storage_dtype",
+    "validate_mode", "wire_width",
+]

@@ -51,7 +51,7 @@ from arbius_tpu.node import (
     NodeDB,
     RegisteredModel,
 )
-from arbius_tpu.node.config import PipelineConfig, SchedConfig
+from arbius_tpu.node.config import PipelineConfig, PrecisionConfig, SchedConfig
 from arbius_tpu.node.solver import EVIL_CID
 from arbius_tpu.obs import use_obs
 from arbius_tpu.sim.clock import VirtualClock
@@ -152,7 +152,8 @@ class SimHarness:
                  node_cls: type[MinerNode] = MinerNode,
                  pipeline: bool = True,
                  mesh: dict | None = None,
-                 witness: bool = False):
+                 witness: bool = False,
+                 precision: str = "bf16"):
         if scenario.faults.crash_after_commit is not None \
                 and db_path == ":memory:":
             # a restart from :memory: builds an EMPTY NodeDB — the run
@@ -192,6 +193,18 @@ class SimHarness:
             from arbius_tpu.parallel import meshsolve
 
             self.mesh = meshsolve.boot_mesh(dict(mesh))
+        # precision mode (docs/quantization.md): a non-bf16 mode needs
+        # the probe runner (the hash-fake FaultyRunner has no XLA
+        # program to quantize), quantizes the probe weights, and rides
+        # every bucket key / cost tag through the node — SIM101-112
+        # must hold at int8 exactly as at bf16
+        from arbius_tpu.quant import validate_mode
+
+        self.precision = validate_mode(precision)
+        if self.precision != "bf16" and mesh is None:
+            raise ValueError(
+                f"precision {precision!r} needs the probe runner — pass "
+                "mesh={} (probe, no mesh) or a real mesh config")
 
         self.token = TokenLedger()
         self.engine = Engine(self.token, start_time=START_TIME)
@@ -292,13 +305,15 @@ class SimHarness:
             # the mesh-off probe baseline runs the same batch so the
             # chunking is identical and only the layout differs
             mesh=dict(self.mesh_cfg) if self.mesh_cfg else None,
-            canonical_batch=2 if self.mesh_cfg is not None else 1)
+            canonical_batch=2 if self.mesh_cfg is not None else 1,
+            precision=PrecisionConfig(default=self.precision))
         self.result.pipeline_enabled = self.pipeline
         if self.mesh_cfg is not None:
             from arbius_tpu.parallel.meshsolve import ShardedImageProbe
 
             runner = ShardedImageProbe(mesh=self.mesh,
-                                       gate=self.plane.runner_gate)
+                                       gate=self.plane.runner_gate,
+                                       mode=self.precision)
         else:
             runner = FaultyRunner(self.plane)
         registry = ModelRegistry()
@@ -477,7 +492,8 @@ def run_scenario(scenario: Scenario, seed: int, *,
                  node_cls: type[MinerNode] = MinerNode,
                  pipeline: bool = True,
                  mesh: dict | None = None,
-                 witness: bool = False) -> SimResult:
+                 witness: bool = False,
+                 precision: str = "bf16") -> SimResult:
     """Build a world, drive the scenario to quiescence, return the
     auditable result. `node_cls` lets regression tests inject a
     deliberately buggy node (tests/test_sim.py double-commit);
@@ -487,7 +503,10 @@ def run_scenario(scenario: Scenario, seed: int, *,
     meshsolve image probe; ``{}`` selects the probe with no mesh (the
     CID-equality baseline for a meshed run). `witness=True` instruments
     the node with the conclint runtime witness and attaches its report
-    to the result for SIM110 (docs/concurrency.md)."""
+    to the result for SIM110 (docs/concurrency.md). `precision` runs
+    the solves at a quantized mode through the probe runner
+    (docs/quantization.md) — every SIM invariant must hold unchanged."""
     return SimHarness(scenario, seed, db_path=db_path,
                       node_cls=node_cls, pipeline=pipeline,
-                      mesh=mesh, witness=witness).run()
+                      mesh=mesh, witness=witness,
+                      precision=precision).run()

@@ -962,6 +962,192 @@ def _stage_flood(out_path: str, tasks: int = 10000,
     os._exit(0)
 
 
+def _stage_quant_ab(out_path: str) -> None:
+    """quant_ab stage (docs/quantization.md): bf16 vs int8 A/B through
+    the FULL node tick loop on the 8-way CPU harness — config (with a
+    `precision` block) → build_registry (boot-time weight quantization)
+    → MinerNode → staged pipeline. Per mode: sol/h, chip-idle seconds,
+    and the collective-byte counters at dp2·tp2 (quantized tp bytes
+    must come out STRICTLY below bf16's — the 1-byte wire), plus the
+    determinism matrix WITHIN each mode: CIDs byte-identical across
+    aot-cache-off / cold / warm lives, pipeline on/off, and mesh-off vs
+    dp2. Cross-mode CIDs must differ (a mode is its own class). Also
+    runs the simnet clean + crash-restart scenarios at int8 (SIM101-112
+    audited). CPU sanity numbers only, no perf claim; writes
+    BENCH_r13.json."""
+    import json as _json
+    import tempfile
+
+    hb = _Heartbeat("quant_ab")
+    # XLA persistent cache off: the aot cold/warm lives must measure
+    # real compiles (the coldboot-stage rationale)
+    devs = _child_common(cpu=True, n_devices=8, compile_cache=False)
+    platform = devs[0].platform
+
+    from arbius_tpu.chain import WAD, Engine, TokenLedger
+    from arbius_tpu.node import LocalChain, MinerNode, MiningConfig, ModelConfig
+    from arbius_tpu.node.config import (
+        AotCacheConfig,
+        PipelineConfig,
+        PrecisionConfig,
+    )
+    from arbius_tpu.node.factory import build_registry
+
+    N, BATCH = 8, 2
+    raw = {"negative_prompt": "", "width": 128, "height": 128,
+           "num_inference_steps": 2}
+
+    def run_node(mode: str, label: str, *, mesh_cfg=None, pipeline=True,
+                 aot_dir=None, n=N) -> dict:
+        tok = TokenLedger()
+        eng = Engine(tok, start_time=10_000)
+        tok.mint(Engine.ADDRESS, 600_000 * WAD)
+        miner, user = "0x" + "aa" * 20, "0x" + "01" * 20
+        for a in (miner, user):
+            tok.mint(a, 1_000 * WAD)
+            tok.approve(a, Engine.ADDRESS, 10**30)
+        mid = "0x" + eng.register_model(user, user, 0, b"{}").hex()
+        cfg = MiningConfig(
+            models=(ModelConfig(id=mid, template="anythingv3", tiny=True),),
+            canonical_batch=BATCH, compile_cache_dir=None, mesh=mesh_cfg,
+            precision=PrecisionConfig(default=mode),
+            aot_cache=AotCacheConfig(enabled=True, dir=aot_dir)
+            if aot_dir else AotCacheConfig(),
+            pipeline=PipelineConfig(enabled=True, depth=2,
+                                    encode_workers=2, max_inflight_pins=2)
+            if pipeline else PipelineConfig())
+        hb.set(f"quant_ab {mode}/{label}: boot")
+        registry = build_registry(cfg)
+        chain = LocalChain(eng, miner)
+        chain.validator_deposit(100 * WAD)
+        node = MinerNode(chain, cfg, registry)
+        node.boot(skip_self_test=True)
+        while node.tick():
+            pass
+        for i in range(n):
+            eng.submit_task(user, 0, user, bytes.fromhex(mid[2:]), 0,
+                            _json.dumps(dict(raw, prompt=f"quant task {i}"),
+                                        sort_keys=True).encode())
+        hb.set(f"quant_ab {mode}/{label}: {n} solves")
+        t0 = time.perf_counter()
+        for _ in range(128):
+            if node.tick() == 0:
+                break
+        elapsed = time.perf_counter() - t0
+        assert len(eng.solutions) == n, \
+            f"{mode}/{label}: {len(eng.solutions)}/{n}"
+        reg = node.obs.registry
+        out = {
+            "mode": mode,
+            "mesh": mesh_cfg,
+            "solutions": n,
+            "seconds": round(elapsed, 3),
+            "solutions_per_hour": round(3600.0 * n / elapsed, 2),
+            "chip_idle_seconds": round(
+                reg.counter("arbius_chip_idle_seconds_total").value(), 4),
+            "collective_bytes": reg.counter(
+                "arbius_collective_bytes_total",
+                labelnames=("axis",)).summary(),
+            "jit": {
+                "compiles": reg.counter(
+                    "arbius_jit_cache_misses_total").value(),
+                "disk_hits": reg.counter(
+                    "arbius_jit_cache_hits_total",
+                    labelnames=("tier",)).value(tier="disk"),
+            },
+            "cids": sorted("0x" + s.cid.hex()
+                           for s in eng.solutions.values()),
+        }
+        node.close()
+        return out
+
+    modes: dict[str, dict] = {}
+    for mode in ("bf16", "int8"):
+        # headline: dp2·tp2 through the staged pipeline — the layout
+        # whose tp ring traffic the quantized wire shrinks
+        head = run_node(mode, "dp2tp2", mesh_cfg={"dp": 2, "tp": 2})
+        # determinism matrix within the mode (4 tasks each)
+        base = run_node(mode, "base", pipeline=False, n=4)
+        pipe = run_node(mode, "pipe", pipeline=True, n=4)
+        dp2 = run_node(mode, "dp2", mesh_cfg={"dp": 2}, n=4)
+        with tempfile.TemporaryDirectory() as aot:
+            cold = run_node(mode, "aot-cold", pipeline=False, n=4,
+                            aot_dir=aot)
+            warm = run_node(mode, "aot-warm", pipeline=False, n=4,
+                            aot_dir=aot)
+        for label, r in (("pipeline-on", pipe), ("dp2", dp2),
+                         ("aot-cold", cold), ("aot-warm", warm)):
+            assert r["cids"] == base["cids"], \
+                f"{mode}: {label} CIDs diverged from cache-off/sync base"
+        assert warm["jit"]["compiles"] == 0 and \
+            warm["jit"]["disk_hits"] > 0, f"{mode}: warm life compiled"
+        modes[mode] = {
+            "headline": head,
+            "determinism": {"cids_pinned_across":
+                            ["aot-off", "aot-cold", "aot-warm",
+                             "pipeline-on", "pipeline-off", "mesh-off",
+                             "dp2"],
+                            "cids": base["cids"]},
+        }
+    assert modes["bf16"]["determinism"]["cids"] != \
+        modes["int8"]["determinism"]["cids"], \
+        "int8 must be its own determinism class"
+    tp_bf16 = modes["bf16"]["headline"]["collective_bytes"].get(
+        "axis=tp", 0)
+    tp_int8 = modes["int8"]["headline"]["collective_bytes"].get(
+        "axis=tp", 0)
+    assert 0 < tp_int8 < tp_bf16, \
+        f"quantized tp bytes must be strictly below bf16 " \
+        f"({tp_int8} vs {tp_bf16})"
+
+    # simnet at int8: clean + crash-restart under the full invariant
+    # catalog (the probe runner carries the quantized program)
+    hb.set("quant_ab: simnet int8 (clean + crash-restart)")
+    from arbius_tpu.sim.harness import run_scenario
+    from arbius_tpu.sim.invariants import check_all
+    from arbius_tpu.sim.scenario import get_scenario
+
+    sim = {}
+    res = run_scenario(get_scenario("clean"), 0, mesh={},
+                       precision="int8")
+    sim["clean"] = {"violations": [f.text() for f in check_all(res)]}
+    with tempfile.TemporaryDirectory() as d:
+        res = run_scenario(get_scenario("crash-restart"), 0, mesh={},
+                           precision="int8",
+                           db_path=os.path.join(d, "sim.sqlite"))
+        sim["crash-restart"] = {
+            "violations": [f.text() for f in check_all(res)]}
+    assert not sim["clean"]["violations"], sim
+    assert not sim["crash-restart"]["violations"], sim
+
+    line = {
+        "metric": "quant_ab_int8_tp_bytes_vs_bf16",
+        "value": round(tp_int8 / tp_bf16, 4),
+        "unit": ("int8/bf16 tp collective-byte ratio at dp2.tp2 (TINY "
+                 f"128x128x2, canonical_batch={BATCH}, platform="
+                 f"{platform}, 8 virtual devices — CPU A/B sanity, no "
+                 "perf claim)"),
+        "vs_baseline": 0.0,
+        "note": ("quant_ab: bf16 vs int8 through the full node tick "
+                 "loop; per-mode CIDs pinned across cache-off/cold/"
+                 "warm, pipeline on/off, mesh-off vs dp2; simnet "
+                 "clean+crash-restart green at int8 "
+                 "(docs/quantization.md)"),
+        "stage": "quant_ab",
+        "modes": modes,
+        "sim_int8": sim,
+        "elapsed_s": round(time.perf_counter() - _T0, 1),
+    }
+    _emit(out_path, line)
+    with open(os.path.join(_REPO, "BENCH_r13.json"), "w") as f:
+        json.dump({"n_devices": 8, "ok": True, "stage": "quant_ab",
+                   "platform": platform, "result": line}, f, indent=1)
+        f.write("\n")
+    _note("quant_ab: wrote BENCH_r13.json")
+    hb.stop()
+    os._exit(0)
+
+
 def _stage_coldboot(out_path: str) -> None:
     """coldboot stage (docs/compile-cache.md): cold-boot-to-first-
     solution A/B over the AOT executable cache. Three full node lives
@@ -1628,7 +1814,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage",
                     choices=["tiny", "session", "mesh_ab", "sched_ab",
-                             "flood", "coldboot"])
+                             "flood", "coldboot", "quant_ab"])
     ap.add_argument("--out")
     ns = ap.parse_args()
     if ns.stage is not None and not ns.out:
@@ -1645,5 +1831,7 @@ if __name__ == "__main__":
         _stage_flood(ns.out)
     elif ns.stage == "coldboot":
         _stage_coldboot(ns.out)
+    elif ns.stage == "quant_ab":
+        _stage_quant_ab(ns.out)
     else:
         _stage_session(ns.out)

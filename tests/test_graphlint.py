@@ -257,6 +257,106 @@ def test_graph405_bf16_accumulation():
         lambda x: jnp.sum(x), args))
 
 
+def test_graph407_int8_dot_must_accumulate_int32():
+    def narrow(qx, qw):
+        # default promotion: int8 @ int8 accumulates in int8 — wraps
+        return jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())))
+
+    def wide(qx, qw):
+        return jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+
+    args = (jax.ShapeDtypeStruct((4, 8), jnp.int8),
+            jax.ShapeDtypeStruct((8, 4), jnp.int8))
+    hits = run_rules(traced(narrow, args))
+    assert rules_of(hits) == ["GRAPH407"]
+    assert "int32" in hits[0].message
+    assert not run_rules(traced(wide, args))
+
+
+def test_graph407_fp8_dot_must_accumulate_f32():
+    def narrow(qx, qw):
+        # fp8 contraction accumulating in bf16 — the sub-f32 wobble
+        # GRAPH405 polices, one notch lower
+        return jax.lax.dot_general(
+            qx, qw, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.bfloat16)
+
+    def wide(qx, qw):
+        return jax.lax.dot_general(
+            qx, qw, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    args = (jax.ShapeDtypeStruct((4, 8), jnp.float8_e4m3fn),
+            jax.ShapeDtypeStruct((8, 4), jnp.float8_e4m3fn))
+    hits = run_rules(traced(narrow, args))
+    assert rules_of(hits) == ["GRAPH407"]
+    assert "float32" in hits[0].message
+    assert not run_rules(traced(wide, args))
+
+
+def test_graph407_dequant_must_pass_through_f32():
+    def direct(qv, qs):
+        # int8 → bf16 directly: rounds twice, backend-fusion dependent
+        return qv.astype(jnp.bfloat16) * qs.astype(jnp.bfloat16)
+
+    def via_f32(qv, qs):
+        return (qv.astype(jnp.float32) * qs).astype(jnp.bfloat16)
+
+    args = (jax.ShapeDtypeStruct((8, 8), jnp.int8),
+            jax.ShapeDtypeStruct((8,), jnp.float32))
+    hits = run_rules(traced(direct, args))
+    assert "GRAPH407" in rules_of(hits)
+    assert "float32" in hits[0].message
+    assert not run_rules(traced(via_f32, args))
+    # uint8 image bytes → f32 is the codec path and must stay clean
+    assert not run_rules(traced(
+        lambda x: x.astype(jnp.float32) / 255.0,
+        (jax.ShapeDtypeStruct((8, 8, 3), jnp.uint8),)))
+
+
+def test_graph407_quantized_dot_primitive_is_clean_and_waivable():
+    """quant.quantized_dot ships the accumulation contract the rule
+    pins (int32 accum, f32 dequant) — and the waiver machinery treats
+    GRAPH407 exactly like GRAPH405 (spec-level, reason-mandatory)."""
+    from arbius_tpu.quant import quantized_dot
+
+    def qdot(qx, qw, sx, sw):
+        return quantized_dot(qx, qw, sx, sw, "int8")
+
+    args = (jax.ShapeDtypeStruct((4, 8), jnp.int8),
+            jax.ShapeDtypeStruct((8, 4), jnp.int8),
+            jax.ShapeDtypeStruct((4,), jnp.float32),
+            jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert not run_rules(traced(qdot, args))
+
+    def narrow(qx, qw):
+        return jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())))
+
+    bad_args = (jax.ShapeDtypeStruct((4, 8), jnp.int8),
+                jax.ShapeDtypeStruct((8, 4), jnp.int8))
+    waived = traced(narrow, bad_args,
+                    allow=(("GRAPH407", "fixture: wrap-around is the "
+                            "point of this test program"),))
+    assert not run_rules(waived)
+    # --select machinery: GRAPH407 runs (or not) like any GRAPH4xx rule
+    prog = traced(narrow, bad_args)
+    assert rules_of(run_rules(prog, select={"GRAPH407"})) == ["GRAPH407"]
+    assert run_rules(prog, select={"GRAPH405"}) == []
+
+
+def test_graph407_quantized_probe_programs_are_clean():
+    """The shipped quantized programs (probe int8 specs) hold the
+    accumulation/dequant contract — the per-mode goldens pin programs
+    GRAPH407 passes."""
+    from arbius_tpu.parallel import meshsolve
+
+    for spec in meshsolve.trace_specs():
+        if spec.dtype != "int8":
+            continue
+        assert not run_rules(trace_spec(spec)), spec.key
+
+
 def test_graph406_constant_prng_seed():
     def watermark(x):
         key = jax.random.PRNGKey(42)

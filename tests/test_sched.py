@@ -450,10 +450,17 @@ def test_cost_tag_roundtrip_and_ingest():
     key = ("0xmm", 512, 512, 20, "DDIM", None)
     tag = make_cost_tag(key[0], bucket_str(key), "single", 4)
     assert parse_cost_tag(tag) == ("0xmm", "512x512.s20.DDIM.f-",
-                                   "single", 4)
+                                   "single", "bf16", 4)
+    # pre-quant 4-field tags (old snapshots, mixed-version fleets)
+    # parse as bf16 — that is the program they metered
+    assert parse_cost_tag("0xmm|512x512.s20.DDIM.f-|single|n4") == \
+        ("0xmm", "512x512.s20.DDIM.f-", "single", "bf16", 4)
     assert parse_cost_tag(None) is None
     assert parse_cost_tag("0xtask") is None
     assert parse_cost_tag("a|b|c|nx") is None
+    assert parse_cost_tag("a|b|c|bf16|nx") is None
+    # a foreign 5-field tag must never mint an arbitrary mode key
+    assert parse_cost_tag("a|b|c|junk|n2") is None
     m = CostModel(min_samples=2)
     assert m.ingest_samples([(tag, 8.0), (tag, 12.0), (None, 3.0),
                              ("garbage", 1.0)]) == 2
@@ -461,9 +468,33 @@ def test_cost_tag_roundtrip_and_ingest():
     # 8s and 12s over 4 tasks each → 2.0 and 3.0 per task → median 2.5
     assert m.predict("0xmm", "512x512.s20.DDIM.f-", "single") == 2.5
     assert m.predict("0xmm", "512x512.s20.DDIM.f-", "dp2") is None
+    # mode rides the tag: an int8 row never answers for bf16
+    assert m.predict("0xmm", "512x512.s20.DDIM.f-", "single",
+                     "int8") is None
     snap = m.snapshot()
     assert snap["rows"][0]["samples"] == 2
     assert snap["rows"][0]["updated"] == 5
+    assert snap["rows"][0]["mode"] == "bf16"
+
+
+def test_cost_rows_never_merge_across_precision_modes():
+    """The quantserve pin (docs/quantization.md): the same (model,
+    bucket, layout) at different precision modes fits SEPARATE rows —
+    an int8 program's chip-seconds must never blend into (or answer
+    for) its bf16 twin's price."""
+    m = CostModel(min_samples=1)
+    bf = make_cost_tag("0xmm", "512x512.s20.DDIM.f-", "single", 2)
+    q8 = make_cost_tag("0xmm", "512x512.s20.DDIM.f-", "single", 2,
+                       mode="int8")
+    assert bf != q8
+    m.ingest_samples([(bf, 8.0), (bf, 8.0), (q8, 4.0), (q8, 4.0)])
+    m.refit(now=1)
+    assert m.predict("0xmm", "512x512.s20.DDIM.f-", "single") == 4.0
+    assert m.predict("0xmm", "512x512.s20.DDIM.f-", "single",
+                     "int8") == 2.0
+    rows = {(r.mode): r for r in m.sorted_rows()}
+    assert set(rows) == {"bf16", "int8"}
+    assert all(r.samples == 2 for r in rows.values())
 
 
 def test_cost_model_persists_across_node_lives(tmp_path):
@@ -485,8 +516,9 @@ def test_cost_model_persists_across_node_lives(tmp_path):
     m2 = CostModel(min_samples=1)
     db2 = NodeDB(db_path)
     assert m2.load(db2) == len(rows)
-    model, bucket, layout = rows[0][0], rows[0][1], rows[0][2]
-    assert m2.predict(model, bucket, layout) == pytest.approx(rows[0][3])
+    model, bucket, layout, mode = rows[0][:4]
+    assert m2.predict(model, bucket, layout, mode) == \
+        pytest.approx(rows[0][4])
     db2.close()
 
 
@@ -581,6 +613,10 @@ def test_debug_costmodel_endpoint():
     assert payload["jit_warm"] == sorted(node.obs.jit_warm)
     assert payload["layout"] == "single"
     assert payload["cost_model"]["rows"][0]["chip_seconds"] == 4.0
+    # the precision surface (docs/quantization.md): every row carries
+    # its mode and the per-model mode table is served alongside
+    assert payload["cost_model"]["rows"][0]["mode"] == "bf16"
+    assert payload["modes"] == {mid.lower(): "bf16"}
     json.dumps(payload, sort_keys=True)  # JSON-able end to end
     node.close()
 
@@ -639,15 +675,15 @@ def test_costmodel_cli_fit_matches_golden_byte_identical():
 def test_costmodel_cli_dump_roundtrips_sqlite(tmp_path):
     db = NodeDB(str(tmp_path / "x.sqlite"))
     db.upsert_cost_rows([("0xaa", "512x512.s20.DDIM.f-", "single",
-                          3.25, 12, 99)])
+                          "bf16", 3.25, 12, 99)])
     db.close()
     rc, out = _run_cli(["--db", str(tmp_path / "x.sqlite"), "--dump",
                         "--json"])
     assert rc == 0
     rows = json.loads(out)["rows"]
     assert rows == [{"model": "0xaa", "bucket": "512x512.s20.DDIM.f-",
-                     "layout": "single", "chip_seconds": 3.25,
-                     "samples": 12, "updated": 99}]
+                     "layout": "single", "mode": "bf16",
+                     "chip_seconds": 3.25, "samples": 12, "updated": 99}]
     rc, txt = _run_cli(["--db", str(tmp_path / "x.sqlite"), "--dump"])
     assert rc == 0 and "512x512.s20.DDIM.f-" in txt
 

@@ -36,10 +36,10 @@ def render_rows(rows: list[dict]) -> str:
     if not rows:
         return "(no fitted rows)"
     head = {"model": "model", "bucket": "bucket", "layout": "layout",
-            "chip_seconds": "chip_seconds", "samples": "samples",
-            "updated": "updated"}
-    cols = ["model", "bucket", "layout", "chip_seconds", "samples",
-            "updated"]
+            "mode": "mode", "chip_seconds": "chip_seconds",
+            "samples": "samples", "updated": "updated"}
+    cols = ["model", "bucket", "layout", "mode", "chip_seconds",
+            "samples", "updated"]
 
     def cell(row, c):
         v = row[c]
@@ -59,8 +59,8 @@ def load_db_rows(db_path: str) -> list[dict]:
 
     db = NodeDB(db_path)
     try:
-        return [CostRow(m, b, l, cs, n, up).to_json()
-                for m, b, l, cs, n, up in db.load_cost_rows()]
+        return [CostRow(m, b, l, cs, n, up, mode=md).to_json()
+                for m, b, l, md, cs, n, up in db.load_cost_rows()]
     finally:
         db.close()
 
