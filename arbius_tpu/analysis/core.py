@@ -344,6 +344,26 @@ def analyze_tree(paths: list[str], root: str | None = None,
     root = os.path.abspath(root or os.getcwd())
     findings: list[Finding] = []
     analyzed: set[str] = set()
+    # whole-package scan of arbius_tpu/ → the OBS501 doc-rot direction
+    # runs too (rules_obs.doc_rot_findings): a documented metric whose
+    # literal vanished from the tree is only decidable with the WHOLE
+    # tree in hand, so partial runs never false-positive on it. "The
+    # package" is <root>/arbius_tpu — the SAME root the relpath prefix
+    # below uses — so a scanned dir counts iff it IS that package dir
+    # or an ancestor of it (a superset scan like the repo root); a
+    # NESTED arbius_tpu (a test fixture tree) never triggers the pass,
+    # because its files would not land in `sources` anyway
+    pkg = os.path.join(root, "arbius_tpu")
+
+    def _covers_package(p: str) -> bool:
+        ap = os.path.abspath(p)
+        if not os.path.isdir(ap) or not os.path.isdir(pkg):
+            return False
+        return ap == pkg or pkg.startswith(ap + os.sep)
+
+    full_tree = any(_covers_package(p) for p in paths) and \
+        (select is None or "OBS501" in select)
+    sources: dict[str, str] = {}
     for abspath, relpath in iter_python_files(paths, root):
         try:
             # tokenize.open honors PEP 263 coding declarations
@@ -354,7 +374,13 @@ def analyze_tree(paths: list[str], root: str | None = None,
             # exit (1) — CI must distinguish "dirty" from "broken"
             raise AnalysisError(f"{relpath}: unreadable: {e}") from e
         analyzed.add(relpath)
+        if full_tree and relpath.startswith("arbius_tpu/"):
+            sources[relpath] = source
         findings.extend(analyze_source(source, relpath, select=select))
+    if full_tree:
+        from arbius_tpu.analysis import rules_obs
+
+        findings.extend(rules_obs.doc_rot_findings(root, sources))
     findings.sort()
     return findings, analyzed
 

@@ -14,13 +14,28 @@ PRs). OBS501 closes the loop:
           a deliberate exception takes the usual reason-mandatory
           `# detlint: allow[OBS501] why` pragma.
 
+The rule also runs the OTHER direction — doc rot: when a whole-package
+scan covers `arbius_tpu/` (analyze_tree detects a directory named
+`arbius_tpu` among its inputs), every `arbius_*` token in
+docs/observability.md must still occur somewhere in the scanned
+sources; a row whose metric literal vanished from the tree is an
+OBS501 finding anchored on the DOC line. Rows documenting an f-string
+family (`f"arbius_{name}_total"` → any `arbius_*_total`) are matched
+against the family's static parts — the same honesty bound as the
+forward direction, inverted.
+
 Honesty bounds: only STRING LITERAL names are checked (an f-string like
 `f"arbius_{name}_total"` names a family, not a metric — its members are
 documented as explicit rows); only attribute calls named exactly
 counter/gauge/histogram are matched, the shape every registry call site
 in this repo uses. The documented-name set is the `arbius_[a-z0-9_]+`
 tokens of docs/observability.md, read once per process — file content,
-never filesystem order, so the rule stays deterministic.
+never filesystem order, so the rule stays deterministic. The doc-rot
+direction reads the doc relative to the analysis ROOT when
+`<root>/docs/observability.md` exists (so fixture trees carry their
+own doc), and considers ANY occurrence of the token in a scanned
+source — string, comment, or docstring — as alive: it flags only
+metrics that vanished entirely.
 """
 from __future__ import annotations
 
@@ -68,6 +83,58 @@ def _literal_name(call: ast.Call) -> ast.Constant | None:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node
     return None
+
+
+# f-string metric families in source text: `f"arbius_{name}_total"` —
+# the {…} hole matched as one metric-name segment
+_FAMILY = re.compile(r"arbius_[a-z0-9_]*(?:\{[^}\"']*\}[a-z0-9_]*)+")
+
+
+def _family_patterns(sources: dict[str, str]) -> list[re.Pattern]:
+    pats = []
+    for src in sources.values():
+        for fam in sorted(set(_FAMILY.findall(src))):
+            parts = re.split(r"\{[^}]*\}", fam)
+            pats.append(re.compile(
+                "[a-z0-9_]+".join(re.escape(p) for p in parts) + r"\Z"))
+    return pats
+
+
+def doc_rot_findings(root: str, sources: dict[str, str]) -> list:
+    """OBS501's doc-rot direction (whole-package scans only — see the
+    module docstring): every `arbius_*` token in docs/observability.md
+    must still occur in the scanned sources, literally or as a member
+    of an f-string family. Findings anchor on the doc line (first
+    occurrence per token), path-relative to the analysis root."""
+    from arbius_tpu.analysis.core import Finding
+
+    doc_path = os.path.join(root, "docs", "observability.md")
+    try:
+        with open(doc_path, encoding="utf-8") as fh:
+            doc_lines = fh.read().splitlines()
+    except OSError:
+        return []  # no doc in this tree = no contract to rot
+    alive: set[str] = set()
+    for src in sources.values():
+        alive.update(_TOKEN.findall(src))
+    patterns = _family_patterns(sources)
+    findings = []
+    seen: set[str] = set()
+    for lineno, line in enumerate(doc_lines, 1):
+        for token in _TOKEN.findall(line):
+            if token in seen or token in alive or \
+                    any(p.match(token) for p in patterns):
+                continue
+            seen.add(token)
+            findings.append(Finding(
+                path="docs/observability.md", line=lineno, col=0,
+                rule="OBS501", severity="error",
+                message=(f"documented metric `{token}` no longer occurs "
+                         "anywhere in the scanned tree — the row is doc "
+                         "rot; delete it (or restore the metric): the "
+                         "operator doc is a contract, not a suggestion"),
+                snippet=line.strip()))
+    return findings
 
 
 @rule("OBS501", "error",

@@ -164,10 +164,26 @@ class AotCache:
         fn = self.load(key, tag=tag)
         if fn is not None:
             return fn, "disk"
+        import time
+
+        # detlint: allow[DET101] obs compile timing; never reaches solve bytes
+        t0 = time.perf_counter()
         with compile_timer(tag):
             compiled = jfn.lower(*args).compile()
+        # detlint: allow[DET101] obs compile timing; never reaches solve bytes
+        dt = time.perf_counter() - t0
+        obs = current_obs()
+        scope = getattr(obs, "perfscope", None) if obs is not None else None
+        perf = None
+        if scope is not None:
+            # perfscope capture (docs/perfscope.md): the card reads
+            # XLA's analyses off the fresh executable, and its compact
+            # perf block rides the entry header so a future disk-hit
+            # life amortizes the ORIGINAL compile cost
+            perf = scope.record_executable(tag, compiled,
+                                           compile_seconds=dt)
         self.store(key, compiled, program=fp, arg_sig=arg_sig, tag=tag,
-                   donate_sig=donate_sig)
+                   donate_sig=donate_sig, perf=perf)
         return compiled, "compiled"
 
     def load(self, key: str, *, tag: str | None = None):
@@ -227,11 +243,19 @@ class AotCache:
                 "arbius_aot_load_seconds", _LOAD_SECONDS_HELP).observe(
                 # detlint: allow[DET101] obs load timing; never reaches solve bytes
                 time.perf_counter() - t0, tag=tag)
+            scope = getattr(obs, "perfscope", None)
+            if scope is not None:
+                # a disk hit carries its card across lives: analyses
+                # re-run on the deserialized executable, but the
+                # ORIGINAL compile cost only survives in the header's
+                # perf block (docs/perfscope.md amortization)
+                scope.record_executable(tag, fn, source="disk",
+                                        header_perf=header.get("perf"))
         return fn
 
     def store(self, key: str, compiled, *, program: str = "",
               arg_sig: str = "", tag: str | None = None,
-              donate_sig: str = "") -> str | None:
+              donate_sig: str = "", perf: dict | None = None) -> str | None:
         """Serialize + publish one compiled executable (atomic), then
         enforce the LRU budget. The header records the key's derivation
         components so `--verify` can re-derive it offline.
@@ -270,7 +294,7 @@ class AotCache:
             return None
         header = make_header(key, program, self.env(), arg_sig, payload,
                              tag=tag, donate_sig=donate_sig,
-                             layout=self.layout)
+                             layout=self.layout, perf=perf)
         try:
             path = write_entry(self.dir, key, header, payload)
         except OSError as e:

@@ -112,14 +112,20 @@ def derive_key(program_fingerprint: str, env: dict, arg_sig: str,
 
 def make_header(key: str, program_fingerprint: str, env: dict,
                 arg_sig: str, payload: bytes, *, tag: str | None = None,
-                donate_sig: str = "", layout: str = "single") -> dict:
+                donate_sig: str = "", layout: str = "single",
+                perf: dict | None = None) -> dict:
     # `layout` is advisory metadata for the warm SCAN only (the mesh
     # layout of the writer's solve programs — docs/multichip.md
     # mesh_tag): the cache KEY already separates layouts through the
     # fingerprint + arg shardings, but a scan cannot trace, so without
     # this field a tp2 worker would count a dp2 worker's entries as
-    # disk-warm and boost exactly the buckets it cannot load
-    return {
+    # disk-warm and boost exactly the buckets it cannot load.
+    # `perf` (optional, docs/perfscope.md) is the writer's PerfCard
+    # block — flops/bytes/HBM sizes and the ORIGINAL compile seconds —
+    # so a disk-hit life amortizes the real compile cost instead of
+    # pretending a deserialize was free. Advisory like `layout`: it is
+    # NOT part of the key, and absent on pre-perfscope entries.
+    header = {
         "format": 1,
         "key": key,
         "program": program_fingerprint,
@@ -131,6 +137,9 @@ def make_header(key: str, program_fingerprint: str, env: dict,
         "payload_sha256": hashlib.sha256(payload).hexdigest(),
         "payload_len": len(payload),
     }
+    if perf is not None:
+        header["perf"] = dict(perf)
+    return header
 
 
 # -- file format -------------------------------------------------------------

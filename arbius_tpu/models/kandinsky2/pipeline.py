@@ -320,7 +320,7 @@ class Kandinsky2Pipeline:
                 (batch, height, width, num_inference_steps, scheduler),
                 self.mesh, images, batch, params=params,
                 wire_dtype=storage_dtype(self.precision)
-                if self.precision != "bf16" else None)
+                if self.precision != "bf16" else None, tag=tag)
         if as_device:
             # async-dispatch handle: the solver's chunk pipeline encodes
             # the previous chunk while the chip crunches this one

@@ -343,7 +343,7 @@ class SD15Pipeline:
                 (batch, height, width, num_inference_steps, scheduler),
                 self.mesh, images, batch, params=params,
                 wire_dtype=storage_dtype(self.precision)
-                if self.precision != "bf16" else None)
+                if self.precision != "bf16" else None, tag=tag)
         if as_device:
             return images
         return np.asarray(images)

@@ -297,7 +297,7 @@ class Text2VideoPipeline:
                 self._coll_est,
                 (batch, num_frames, height, width, num_inference_steps,
                  scheduler),
-                self.mesh, out, batch)
+                self.mesh, out, batch, tag=tag)
         if as_device:
             # async-dispatch handle: the video runner's chunk pipeline
             # muxes the previous chunk while the chip crunches this one

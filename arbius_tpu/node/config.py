@@ -218,6 +218,44 @@ class AotCacheConfig:
 
 
 @dataclass(frozen=True)
+class PerfscopeConfig:
+    """Per-bucket XLA cost/memory attribution + drift detection
+    (docs/perfscope.md): capture a PerfCard (flops, bytes accessed, HBM
+    sizes, padding waste, wire bytes, compile amortization) for every
+    bucket executable at the compile seam, persist cards to the sqlite
+    `perf_cards` table, and publish
+    `arbius_perf_drift_ratio{model,bucket,layout,mode}` = observed
+    infer p50 ÷ the card's static roofline estimate.
+
+    Disabled by default — `enabled: false` IS the pre-perfscope node
+    bit-for-bit (no capture, no eager compile at the lookup). Enabling
+    never changes a program or its bytes: CIDs are pinned identical on
+    vs off (tests/test_perfscope.py)."""
+    enabled: bool = False
+    # roofline peaks the static estimate divides by — set them to the
+    # deployed accelerator (defaults are a v4-ish order of magnitude;
+    # on CPU the ratio is only meaningful relative to itself)
+    peak_flops: float = 1e12
+    peak_bytes_per_second: float = 8e11
+    # drift band: a ratio outside [drift_min, drift_max] journals a
+    # `perf_drift` event (on the crossing) and is what PERF601 audits
+    # offline. drift_max 0 disables live banding — the gauge and cards
+    # still publish.
+    drift_min: float = 0.0
+    drift_max: float = 0.0
+
+    def __post_init__(self):
+        if self.peak_flops < 0 or self.peak_bytes_per_second < 0:
+            raise ConfigError("perfscope peaks must be >= 0 "
+                              "(0 disables that roofline term)")
+        if self.drift_min < 0:
+            raise ConfigError("perfscope.drift_min must be >= 0")
+        if self.drift_max > 0 and self.drift_max < self.drift_min:
+            raise ConfigError("perfscope.drift_max must be >= drift_min "
+                              "(or 0 to disable live banding)")
+
+
+@dataclass(frozen=True)
 class SLOConfig:
     """First-class service-level objectives over the fleet's chain-time
     latency corpus (docs/fleetscope.md): each threshold declares an
@@ -419,6 +457,10 @@ class MiningConfig:
     # "bf16" everywhere IS the pre-quant node byte-for-byte — int8/fp8
     # are opt-in per-template determinism classes
     precision: PrecisionConfig = PrecisionConfig()
+    # per-bucket cost/memory attribution + drift detection
+    # (docs/perfscope.md); default OFF = no capture, the pre-perfscope
+    # compile seam bit-for-bit
+    perfscope: PerfscopeConfig = PerfscopeConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -517,9 +559,11 @@ def load_config(raw: str | dict) -> MiningConfig:
                       "aot_cache")
     precision = build(PrecisionConfig, obj.pop("precision", {}),
                       "precision")
+    perfscope = build(PerfscopeConfig, obj.pop("perfscope", {}),
+                      "perfscope")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
                       ipfs=ipfs, pipeline=pipeline, sched=sched,
                       fleet=fleet, slo=slo, aot_cache=aot_cache,
-                      precision=precision, **obj),
+                      precision=precision, perfscope=perfscope, **obj),
                  "config")

@@ -63,6 +63,10 @@ CREATE TABLE IF NOT EXISTS cost_model (
     model TEXT, bucket TEXT, layout TEXT, mode TEXT DEFAULT 'bf16',
     chip_seconds REAL, samples INT, updated INT,
     PRIMARY KEY (model, bucket, layout, mode));
+CREATE TABLE IF NOT EXISTS perf_cards (
+    model TEXT, bucket TEXT, layout TEXT, mode TEXT DEFAULT 'bf16',
+    card TEXT, updated INT,
+    PRIMARY KEY (model, bucket, layout, mode));
 CREATE INDEX IF NOT EXISTS jobs_priority ON jobs(priority);
 """
 
@@ -394,6 +398,30 @@ class NodeDB:
         with self._lock:
             self._conn.execute("DELETE FROM cost_model")
             self._commit()
+
+    # -- perf cards (docs/perfscope.md) ----------------------------------
+    def upsert_perf_cards(self, rows: list[tuple]) -> None:
+        """Persist perfscope cards: (model, bucket, layout, mode,
+        card_json, updated). Written inside the tick's batch window —
+        like cost rows, cards cost no extra fsync."""
+        with self._lock:
+            self._conn.executemany(
+                "INSERT OR REPLACE INTO perf_cards (model, bucket,"
+                " layout, mode, card, updated) VALUES (?,?,?,?,?,?)",
+                rows)
+            self._commit()
+
+    def load_perf_cards(self) -> list[tuple]:
+        """Every persisted (model, bucket, layout, mode, card_dict,
+        updated) row, deterministically ordered — what the
+        tools/perfscope.py auditor and the costmodel --dump join read."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT model, bucket, layout, mode, card, updated"
+                " FROM perf_cards ORDER BY model, bucket, layout, mode")
+            return [(r["model"], r["bucket"], r["layout"], r["mode"],
+                     json.loads(r["card"]), int(r["updated"]))
+                    for r in rows]
 
     def store_contestation(self, taskid: str, validator: str,
                            blocktime: int) -> None:

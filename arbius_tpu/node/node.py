@@ -126,6 +126,20 @@ class MinerNode:
         self.obs = Obs(journal_capacity=config.obs_journal_capacity,
                        now_fn=lambda: self.chain.now,
                        enabled=config.obs_enabled)
+        if config.perfscope.enabled:
+            # perfscope card capture (docs/perfscope.md): installed on
+            # the obs bundle — like the AOT cache — so every
+            # jit_cache_get under this node's ambient obs records a
+            # PerfCard at compile. Installed at construction, not boot:
+            # the capture has no layout dependency and a non-booted
+            # test node should meter exactly like a booted one.
+            from arbius_tpu.obs.perfscope import PerfScope
+
+            ps = config.perfscope
+            self.obs.perfscope = PerfScope(
+                self.obs, peak_flops=ps.peak_flops,
+                peak_bytes_per_second=ps.peak_bytes_per_second,
+                drift_min=ps.drift_min, drift_max=ps.drift_max)
         reg = self.obs.registry
         for name, help_text in _COUNTERS.items():
             reg.counter(f"arbius_{name}_total", help_text)
@@ -724,13 +738,26 @@ class MinerNode:
         tags = self._disk_warm_tags
         if not tags:
             return False
+        tag = self._bucket_exec_tag(key, entries[0][1])
+        return tag is not None and tag in tags
+
+    def _bucket_exec_tag(self, key: tuple, hydrated: dict) -> str | None:
+        """THE executable-cache tag a dispatch of this bucket would use
+        — the one derivation `bucket_disk_warm` (scheduler disk-warm
+        join) and `_observe_infer` (perf-card bind) both ride, so the
+        two joins can never desynchronize. Defers to the runner's
+        `cache_tag`, which defers to the pipeline's one `bucket_tag`
+        definition (docs/compile-cache.md). None when the runner has no
+        tag surface or derivation fails."""
         m = self.registry.get(key[0])
         cache_tag = getattr(m.runner, "cache_tag", None) \
             if m is not None else None
         if cache_tag is None:
-            return False
-        tag = cache_tag(entries[0][1], max(1, self.config.canonical_batch))
-        return tag in tags
+            return None
+        try:
+            return cache_tag(hydrated, max(1, self.config.canonical_batch))
+        except Exception:  # noqa: BLE001 — a tag is advisory metadata
+            return None
 
     def _bucket_fees(self, entries: list) -> int:
         """Summed task fees of one bucket (the packer's reward side):
@@ -753,6 +780,14 @@ class MinerNode:
             if self.costmodel.ingest(self._h_stage):
                 self.costmodel.refit(self.chain.now)
                 self.costmodel.persist(self.db, self.chain.now)
+        scope = self.obs.perfscope
+        if scope is not None:
+            # perfscope cards ride the same batch window as cost rows
+            # (docs/perfscope.md): dirty cards persist once per tick,
+            # no extra fsync
+            rows = scope.dirty_rows(self.chain.now)
+            if rows:
+                self.db.upsert_perf_cards(rows)
 
     def _process_solve_batch(self, jobs: list[Job]) -> int:
         """Group solve jobs by shape bucket, pack the buckets (FIFO by
@@ -813,6 +848,47 @@ class MinerNode:
         return make_cost_tag(key[0], bucket_str(key), self.solve_layout, n,
                              mode=bucket_mode(key))
 
+    def _observe_infer(self, key: tuple, n: int, seconds: float,
+                       hydrated: dict | None = None) -> None:
+        """ONE bucket dispatch's infer observation, shared by both solve
+        schedules: feeds the cost-tagged `arbius_stage_seconds{infer}`
+        sample (the learned model's input) and, when perfscope is
+        installed (docs/perfscope.md), binds the bucket's PerfCard to
+        the same (model, bucket, layout, mode) cost key — with the
+        padding waste `solver.chunk_items` would dispatch for `n` real
+        tasks — and evaluates the drift band. `hydrated` is any one of
+        the bucket's hydrated inputs (the runner's `cache_tag` join
+        key, exactly as `bucket_disk_warm` uses it)."""
+        self._h_stage.observe(seconds, stage="infer",
+                              tag=self._cost_tag(key, n))
+        scope = self.obs.perfscope
+        if scope is None or hydrated is None:
+            return
+        exec_tag = self._bucket_exec_tag(key, hydrated)
+        if exec_tag is None:
+            return
+        from arbius_tpu.node.costmodel import bucket_str
+        from arbius_tpu.node.solver import bucket_mode
+
+        m = self.registry.get(key[0])
+        cb = max(1, self.config.canonical_batch)
+        padded = 0
+        if cb > 1 and getattr(m.runner, "run_batch", None) is not None:
+            # chunk_items pads the last chunk to the canonical batch by
+            # repeating its final real item — those slots burn chip
+            # time without earning fees (the card's padding_waste)
+            chunks = -(-n // cb)
+            padded = chunks * cb - n
+        else:
+            # non-batching runner (or canonical_batch 1): each item is
+            # its own executable dispatch, nothing padded
+            chunks = n
+        scope.observe_dispatch(
+            exec_tag, model=key[0], bucket=bucket_str(key),
+            layout=self.solve_layout, mode=bucket_mode(key),
+            batch=cb, real=n, padded=padded, dispatches=chunks,
+            seconds=seconds)
+
     def _solve_bucket(self, m, entries: list[tuple[Job, dict]],
                       key: tuple) -> int:
         t_start = self.chain.now
@@ -834,10 +910,12 @@ class MinerNode:
         with self.state_lock:
             self._sched.mark_warm(key)
         # tagged with the cost key so the learned model can attribute
-        # the bucket's wall seconds to (model, bucket, layout, n)
+        # the bucket's wall seconds to (model, bucket, layout, n) —
+        # and the perfscope card, when installed, binds on the same key
         # detlint: allow[DET101] obs stage timing; never reaches solve bytes
-        self._h_stage.observe(time.perf_counter() - w_start, stage="infer",
-                              tag=self._cost_tag(key, len(entries)))
+        self._observe_infer(key, len(entries),
+                            time.perf_counter() - w_start,
+                            hydrated=entries[0][1])
         done = 0
         # detlint: allow[DET101] obs stage timing; never reaches solve bytes
         w_commit = time.perf_counter()

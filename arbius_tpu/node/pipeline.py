@@ -119,6 +119,7 @@ class SolvePipeline:
         self._commit_left: dict = {}
         self._commit_acc: dict = {}
         self._bucket_keys: dict = {}
+        self._bucket_h0: dict = {}
         self._bucket_n: dict = {}
         self._cv = threading.Condition()
         # (generation, chunk idx) -> (elapsed, result); guarded by
@@ -257,8 +258,14 @@ class SolvePipeline:
         b = max(1, self.node.config.canonical_batch)
         chunks: list[_Chunk] = []
         self._bucket_keys: dict[int, tuple] = {}
+        # one hydrated input per bucket — the perfscope card bind's
+        # cache_tag join key (node._observe_infer), same element
+        # bucket_disk_warm uses
+        self._bucket_h0: dict[int, dict] = {}
         for bi, (model, entries, key) in enumerate(buckets):
             self._bucket_keys[bi] = key
+            if entries:
+                self._bucket_h0[bi] = entries[0][1]
             items = [(h, h["seed"]) for _, h in entries]
             for ci, (padded, real) in enumerate(chunk_items(items, b)):
                 chunks.append(_Chunk(
@@ -401,14 +408,14 @@ class SolvePipeline:
             self._infer_ok.add(bucket)
         if self._infer_left[bucket] == 0 and bucket in self._infer_ok:
             self._infer_ok.discard(bucket)
-            self.node._h_stage.observe(
+            # cost-tagged (and perfscope-bound) exactly like the serial
+            # path, so the learned model and the card read one signal
+            # whichever schedule ran
+            self.node._observe_infer(
+                self._bucket_keys[bucket], self._bucket_n[bucket],
                 # detlint: allow[DET101] obs stage timing; never reaches solve bytes
                 time.perf_counter() - self._infer_start[bucket],
-                stage="infer",
-                # cost-tagged exactly like the serial path, so the
-                # learned model reads one signal whichever schedule ran
-                tag=self.node._cost_tag(self._bucket_keys[bucket],
-                                        self._bucket_n[bucket]))
+                hydrated=self._bucket_h0.get(bucket))
 
     # -- bookkeeping -------------------------------------------------------
     def _stage_event(self, taskid: str, stage: str, jobid: int,

@@ -582,9 +582,11 @@ class ControlRPC:
             # cost table and feeds the warm set (docs/concurrency.md —
             # the CONC401 finding this view used to be).
             cfg = self.node.config
+            scope = self.node.obs.perfscope
             with self.node.state_lock:
-                return 200, {
-                    "cost_model": self.node.costmodel.snapshot(),
+                cost_model = self.node.costmodel.snapshot()
+                view = {
+                    "cost_model": cost_model,
                     "sched": self.node._sched.snapshot(),
                     # ground truth for the packer's warm preference:
                     # every executable-cache tag that actually compiled
@@ -608,12 +610,56 @@ class ControlRPC:
                     "min_fee_per_second": str(cfg.min_fee_per_second),
                     "static_seconds": self.node._static_solve_seconds(),
                 }
+            if scope is not None:
+                # perfscope join (docs/perfscope.md) OUTSIDE the state
+                # lock: the snapshot above already copied the rows into
+                # fresh dicts, and PerfScope serializes under its own
+                # leaf lock — the tick thread's pack must not wait on
+                # O(rows × cards) JSON work. Every fitted row carries
+                # its card's static facts — fee/flop and utilization
+                # sit NEXT TO the learned chip-seconds, through the
+                # shared (model, bucket, layout, mode) tag.
+                for row in cost_model["rows"]:
+                    cj = scope.card_json_for(row["model"], row["bucket"],
+                                             row["layout"], row["mode"])
+                    if cj is None:
+                        continue
+                    perf = {k: cj[k] for k in (
+                        "flops", "bytes_accessed", "roofline_seconds",
+                        "drift_ratio", "padding_waste",
+                        "amortized_compile_seconds")}
+                    bucket_s = row["chip_seconds"] * max(1, cj["batch"])
+                    if cj["flops"] > 0:
+                        # wad charged per Gflop at the fitted price —
+                        # the cost-per-token discipline of the Gemma
+                        # serving comparison (PAPERS.md), at bucket
+                        # granularity
+                        perf["fee_per_gflop"] = round(
+                            bucket_s * cfg.min_fee_per_second
+                            / (cj["flops"] / 1e9), 6)
+                    if bucket_s > 0 and cj["roofline_seconds"]:
+                        # fraction of the roofline the fitted price
+                        # says this bucket achieves
+                        perf["utilization"] = round(
+                            cj["roofline_seconds"] / bucket_s, 6)
+                    row["perf"] = perf
+            view["perfscope"] = scope.snapshot() \
+                if scope is not None else None
+            return 200, view
         if parts.path == "/debug/trace":
             taskid = (q.get("taskid") or [""])[0]
             if not taskid:
                 return 400, {"error": "taskid query parameter required"}
             trace = self.node.obs.task_trace(taskid)
+            # the task's NON-span lifecycle events inline, in journal
+            # (seq) order: pipeline_stage completions, gate/cost
+            # decisions, dedupes, drift — one ordered view instead of
+            # journal-grep archaeology (docs/perfscope.md); spans keep
+            # their tree shape above
+            events = [e for e in self.node.obs.journal.events(
+                taskid=taskid) if e.get("kind") != "span"]
             return 200, {"taskid": taskid, "spans": trace,
+                         "events": events,
                          "journal_dropped": self.node.obs.journal.dropped}
         if parts.path == "/debug/journal":
             try:

@@ -67,6 +67,10 @@ class Obs:
         # path already rides — None = the memory-only pre-AOT behavior,
         # bit-for-bit
         self.aot_cache = None
+        # perfscope card table (docs/perfscope.md): installed at boot
+        # when cfg.perfscope.enabled, same ambient pattern — None =
+        # no capture, the pre-perfscope node bit-for-bit
+        self.perfscope = None
 
     def span(self, name: str, **attrs):
         if not self.enabled:
@@ -153,7 +157,10 @@ def jit_cache_get(cache: dict, key, build, tag: str | None = None,
     executable is ALREADY compiled, so `warm=True` — the compile/load
     cost was recorded inside (`arbius_compile_seconds` /
     `arbius_aot_load_seconds`) and the first dispatch has nothing left
-    to time."""
+    to time. A `PerfScope` on the active obs (`obs.perfscope`,
+    docs/perfscope.md) rides the same `aot_args` opt-in: misses compile
+    eagerly so the card can read XLA's cost/memory analyses off the
+    compiled executable — same program, same bytes, warm=True."""
     obs = _ACTIVE.get()
     fn = cache.get(key)
     if fn is not None:
@@ -161,6 +168,11 @@ def jit_cache_get(cache: dict, key, build, tag: str | None = None,
             obs.registry.counter("arbius_jit_cache_hits_total",
                                  _JIT_HITS_HELP,
                                  labelnames=("tier",)).inc(tier="memory")
+            if obs.perfscope is not None:
+                # a hit on an already-COMPILED executable (an earlier
+                # life under perfscope/AOT built it eagerly) still
+                # cards the bucket; lazy callables no-op inside
+                obs.perfscope.adopt(tag, fn)
         return fn, True, tag
     aot = obs.aot_cache if obs is not None else None
     if aot is not None and aot_args is not None:
@@ -193,6 +205,34 @@ def jit_cache_get(cache: dict, key, build, tag: str | None = None,
             # makes the rebind atomic while the old frozenset stays
             # valid under its feet (docs/concurrency.md)
             obs.jit_warm = obs.jit_warm | {tag}
+    scope = obs.perfscope if obs is not None else None
+    if scope is not None and aot_args is not None:
+        # perfscope capture (docs/perfscope.md): the card needs the
+        # COMPILED executable (XLA's cost/memory analyses live there),
+        # so the miss compiles eagerly — the aotcache pattern exactly:
+        # the returned executable runs the same program the lazy path
+        # would have built (same trace, XLA's deterministic lowering),
+        # warm=True because the compile was timed here. Any failure
+        # degrades to the lazy pre-perfscope path, journaled — the
+        # scope can never be why a solve fails.
+        fn = build()
+        try:
+            args = tuple(aot_args())
+            import time
+
+            # detlint: allow[DET101] obs compile timing; never reaches solve bytes
+            t0 = time.perf_counter()
+            with compile_timer(tag):
+                compiled = fn.lower(*args).compile()
+            # detlint: allow[DET101] obs compile timing; never reaches solve bytes
+            dt = time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 — degrade, never fail
+            scope._skip("jit_cache_get")
+            cache[key] = fn
+            return fn, False, tag
+        scope.record_executable(tag, compiled, compile_seconds=dt)
+        cache[key] = compiled
+        return compiled, True, tag
     fn = cache[key] = build()
     return fn, False, tag
 

@@ -1,0 +1,606 @@
+"""perfscope — per-bucket XLA cost/memory attribution + drift detection.
+
+The obs stack answers *what happened* (spans, percentiles, SLOs) but
+not *what the program should have cost*. perfscope closes that gap with
+a `PerfCard` per bucket executable: the static compile-time facts XLA
+already knows — FLOPs and bytes accessed (`compiled.cost_analysis()`),
+HBM argument/output/temp/code sizes (`compiled.memory_analysis()`) —
+joined with facts the node derives anyway (padding waste from
+`solver.chunk_items`' canonical-batch padding, collective wire bytes
+from `meshsolve.estimate_collective_bytes`, compile-seconds
+amortization across dispatches, cross-life via the aotcache header's
+optional `perf` block). These are exactly the program-derived features
+"A Learned Performance Model for Tensor Processing Units" (PAPERS.md)
+fits over, recorded at the one seam every bucket executable already
+passes through (`obs.jit_cache_get`).
+
+Cards are keyed twice:
+
+  * at CAPTURE by the executable cache tag (`bucket_tag` — the same
+    string the jit warm set, the AOT cache, and the scheduler's
+    disk-warm join all use), because that is all the compile seam
+    knows;
+  * at BIND by the cost model's (model, bucket, layout, mode) key
+    (node/costmodel.make_cost_tag fields), attached on the first
+    dispatch the node attributes to the card — so `CostModel` rows,
+    `/debug/costmodel`, and `tools/costmodel.py --dump` join fitted
+    chip-seconds against flops/bytes through the shared tag.
+
+Drift detection: `arbius_perf_drift_ratio{model,bucket,layout,mode}` =
+observed infer p50 ÷ the card's static roofline estimate
+(max(flops/peak_flops, bytes/peak_bytes_per_second) — the classic
+roofline lower bound). A ratio that leaves the configured band journals
+a `perf_drift` event here and raises a PERF601 finding offline
+(tools/perfscope.py): the fail-closed "your price model is lying"
+signal — a mispriced bucket, a padding-wasteful chunk, or a quant mode
+that stopped paying for itself shows up as drift, not as a bleeding
+profitability gate (docs/perfscope.md).
+
+Determinism: perfscope reads executables and wall clocks; it never
+touches a dispatch's operands or program, so CIDs are byte-identical
+perfscope-on vs off (tests/test_perfscope.py pins the image probe at
+mesh-off and dp2, the seq probe, and a real tiny SD-1.5). Capture
+failures degrade to the exact pre-perfscope path — the scope can never
+be why a solve fails.
+
+`chrome_trace` at the bottom renders journal span chains (single node
+or fleet-federated) as a Chrome/Perfetto trace.json — every task
+lifecycle (and cross-process lease hop) visually inspectable.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+# bounded per-card window of observed whole-bucket infer walls — the
+# drift ratio's p50 comes from here (matches the obs histograms'
+# bounded-window philosophy)
+OBSERVED_WINDOW = 64
+
+_DRIFT_HELP = ("Observed infer p50 over the card's static roofline "
+               "estimate, per (model, bucket, layout, mode) — outside "
+               "the configured band the node journals perf_drift and "
+               "tools/perfscope.py raises PERF601 (docs/perfscope.md)")
+_CARDS_HELP = ("PerfCards captured this life (one per bucket "
+               "executable that compiled or loaded under perfscope)")
+_SKIPS_HELP = ("Perf-card captures skipped because XLA's cost/memory "
+               "analysis (or the eager compile) failed — the dispatch "
+               "degraded to the exact pre-perfscope path, journaled "
+               "perf_capture_skip; never a failed solve")
+
+
+def roofline_seconds(flops: float, bytes_accessed: float,
+                     peak_flops: float, peak_bytes_per_second: float
+                     ) -> float:
+    """The static roofline lower bound: a program can finish no faster
+    than its FLOPs at peak compute or its memory traffic at peak
+    bandwidth, whichever dominates. 0.0 when nothing is known (an
+    unanalyzable executable) — callers treat 0 as 'no estimate'."""
+    est = 0.0
+    if peak_flops > 0 and flops > 0:
+        est = max(est, float(flops) / peak_flops)
+    if peak_bytes_per_second > 0 and bytes_accessed > 0:
+        est = max(est, float(bytes_accessed) / peak_bytes_per_second)
+    return est
+
+
+@dataclass
+class PerfCard:
+    """One bucket executable's static cost/memory facts + the derived
+    serving facts the node joins in. Mutable: dispatch accounting
+    accrues under the scope's lock."""
+
+    tag: str                     # executable cache tag (bucket_tag)
+    # -- XLA static facts (capture time) --------------------------------
+    flops: float = 0.0           # cost_analysis "flops"
+    bytes_accessed: float = 0.0  # cost_analysis "bytes accessed"
+    arg_bytes: int = 0           # memory_analysis argument_size_in_bytes
+    out_bytes: int = 0           # memory_analysis output_size_in_bytes
+    temp_bytes: int = 0          # memory_analysis temp_size_in_bytes
+    code_bytes: int = 0          # generated_code_size_in_bytes
+    compile_seconds: float = 0.0
+    source: str = "compiled"     # compiled | disk | header
+    roofline_s: float = 0.0      # static estimate at capture-time peaks
+    # -- cost-key bind (first attributed dispatch) ----------------------
+    model: str | None = None
+    bucket: str | None = None
+    layout: str | None = None
+    mode: str | None = None
+    batch: int = 0               # canonical batch the bind saw
+    # -- serving accrual ------------------------------------------------
+    dispatches: int = 0          # executable invocations (chunk count)
+    real_tasks: int = 0
+    padded_slots: int = 0        # chunk_items padding slots dispatched
+    wire_bytes: dict = field(default_factory=dict)  # {axis: bytes}/disp
+    # PER-DISPATCH infer walls (bucket wall ÷ chunk count): comparable
+    # to roofline_s — one program invocation each — whatever the queue
+    observed: deque = field(default_factory=lambda: deque(
+        maxlen=OBSERVED_WINDOW))
+
+    @property
+    def bound(self) -> bool:
+        return self.model is not None
+
+    def padding_waste(self) -> float:
+        """Fraction of dispatched batch slots that were chunk_items
+        padding (repeat-of-last-real samples burning chip time)."""
+        total = self.real_tasks + self.padded_slots
+        return self.padded_slots / total if total else 0.0
+
+    def observed_p50(self) -> float | None:
+        vals = sorted(self.observed)
+        if not vals:
+            return None
+        return float(vals[len(vals) // 2])
+
+    def drift_ratio(self) -> float | None:
+        """Observed per-dispatch infer p50 ÷ the static roofline; None
+        until both sides exist."""
+        p50 = self.observed_p50()
+        if p50 is None or self.roofline_s <= 0:
+            return None
+        return p50 / self.roofline_s
+
+    def amortized_compile_seconds(self) -> float:
+        """Compile cost ÷ dispatches this life (cross-life dispatches
+        ride the persisted card; a disk-sourced card amortizes the
+        ORIGINAL compile cost from the aotcache header's perf block)."""
+        return self.compile_seconds / self.dispatches \
+            if self.dispatches else self.compile_seconds
+
+    def perf_block(self) -> dict:
+        """The compact JSON block the aotcache header carries
+        (docs/compile-cache.md): enough for a warm boot to re-seed a
+        card without re-running XLA's analyses."""
+        return {"flops": float(self.flops),
+                "bytes_accessed": float(self.bytes_accessed),
+                "arg_bytes": int(self.arg_bytes),
+                "out_bytes": int(self.out_bytes),
+                "temp_bytes": int(self.temp_bytes),
+                "code_bytes": int(self.code_bytes),
+                "compile_seconds": round(float(self.compile_seconds), 6)}
+
+    def to_json(self) -> dict:
+        out = {
+            "tag": self.tag,
+            "model": self.model, "bucket": self.bucket,
+            "layout": self.layout, "mode": self.mode,
+            "batch": self.batch,
+            "flops": float(self.flops),
+            "bytes_accessed": float(self.bytes_accessed),
+            "arg_bytes": int(self.arg_bytes),
+            "out_bytes": int(self.out_bytes),
+            "temp_bytes": int(self.temp_bytes),
+            "code_bytes": int(self.code_bytes),
+            "compile_seconds": round(float(self.compile_seconds), 6),
+            "source": self.source,
+            "roofline_seconds": round(float(self.roofline_s), 9),
+            "dispatches": self.dispatches,
+            "real_tasks": self.real_tasks,
+            "padded_slots": self.padded_slots,
+            "padding_waste": round(self.padding_waste(), 6),
+            "amortized_compile_seconds": round(
+                self.amortized_compile_seconds(), 6),
+            "wire_bytes": {k: int(v) for k, v in
+                           sorted(self.wire_bytes.items())},
+        }
+        drift = self.drift_ratio()
+        out["drift_ratio"] = round(drift, 6) if drift is not None else None
+        p50 = self.observed_p50()
+        out["observed_p50_seconds"] = round(p50, 6) \
+            if p50 is not None else None
+        return out
+
+
+def analyze_executable(compiled) -> dict:
+    """Best-effort XLA analysis of a compiled (or deserialized)
+    executable → the raw card fields. Never raises: each analysis is
+    independently guarded — a backend that implements cost_analysis
+    but not memory_analysis still yields its flops."""
+    out = {"flops": 0.0, "bytes_accessed": 0.0, "arg_bytes": 0,
+           "out_bytes": 0, "temp_bytes": 0, "code_bytes": 0}
+    try:
+        ca = compiled.cost_analysis()
+        # jax returns one properties dict per partition (a list) on
+        # some versions, a bare dict on others
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out["flops"] = float(ca.get("flops", 0.0) or 0.0)
+        out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0) or 0.0)
+    except Exception:  # noqa: BLE001 — analysis is optional, per field
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        out["arg_bytes"] = int(getattr(ma, "argument_size_in_bytes", 0))
+        out["out_bytes"] = int(getattr(ma, "output_size_in_bytes", 0))
+        out["temp_bytes"] = int(getattr(ma, "temp_size_in_bytes", 0))
+        out["code_bytes"] = int(getattr(
+            ma, "generated_code_size_in_bytes", 0))
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+class PerfScope:
+    """One node's card table. Installed on the `Obs` bundle
+    (`obs.perfscope`, like `obs.aot_cache`) so `jit_cache_get` finds it
+    ambiently; None = perfscope off, bit-for-bit the pre-perfscope
+    node. All mutable state lives under one leaf lock (`_lock` is never
+    held while taking any other lock), so the tick thread's capture and
+    a /debug request thread's snapshot cannot race
+    (docs/concurrency.md)."""
+
+    def __init__(self, obs=None, *, peak_flops: float = 1e12,
+                 peak_bytes_per_second: float = 8e11,
+                 drift_min: float = 0.0, drift_max: float = 0.0):
+        self.obs = obs
+        self.peak_flops = float(peak_flops)
+        self.peak_bytes_per_second = float(peak_bytes_per_second)
+        # drift band: ratio outside [drift_min, drift_max] journals
+        # perf_drift; drift_max <= 0 disables banding (the gauge and
+        # the cards still publish — the offline auditor brings its own
+        # band, docs/perfscope.md)
+        self.drift_min = float(drift_min)
+        self.drift_max = float(drift_max)
+        self._lock = threading.Lock()
+        self._cards: dict[str, PerfCard] = {}   # by executable tag
+        self._breached: set[str] = set()        # tags currently outside
+        self._dirty: set[str] = set()           # bound cards to persist
+        # memory-hit adoption's negative cache: tags whose cached
+        # callable yielded no analysis (lazy jitted fns) — without it
+        # every hot-path dispatch would re-attempt the analyses forever
+        self._unanalyzable: set[str] = set()
+        if obs is not None:
+            reg = obs.registry
+            reg.gauge("arbius_perf_cards", _CARDS_HELP,
+                      fn=self._card_count)
+            reg.gauge("arbius_perf_drift_ratio", _DRIFT_HELP,
+                      labelnames=("model", "bucket", "layout", "mode"),
+                      fn=self._drift_ratios)
+            self._c_skips = reg.counter(
+                "arbius_perf_capture_skips_total", _SKIPS_HELP)
+        else:
+            self._c_skips = None
+
+    # -- collect-time gauge sources --------------------------------------
+    def _card_count(self) -> float:
+        with self._lock:
+            return float(len(self._cards))
+
+    def _drift_ratios(self) -> dict:
+        out = {}
+        with self._lock:
+            for card in self._cards.values():
+                if not card.bound:
+                    continue
+                drift = card.drift_ratio()
+                if drift is not None:
+                    out[(card.model, card.bucket, card.layout,
+                         card.mode)] = drift
+        return out
+
+    # -- capture (the jit_cache_get / aotcache seam) ---------------------
+    def record_executable(self, tag: str | None, compiled, *,
+                          compile_seconds: float = 0.0,
+                          source: str = "compiled",
+                          header_perf: dict | None = None,
+                          _analyzed: dict | None = None) -> dict | None:
+        """Capture one executable's card. `header_perf` (an aotcache
+        header's perf block) seeds the fields when given — a
+        deserialized executable's analyses answer for the same program,
+        but the ORIGINAL compile cost only survives in the header.
+        Returns the card's perf block (for the aotcache header), or
+        None when nothing could be captured. Never raises."""
+        if tag is None:
+            return None
+        try:
+            raw = dict(header_perf) if header_perf else {}
+            analyzed = _analyzed if _analyzed is not None \
+                else analyze_executable(compiled)
+            for k, v in analyzed.items():
+                if not raw.get(k):
+                    raw[k] = v
+            if compile_seconds and not raw.get("compile_seconds"):
+                raw["compile_seconds"] = compile_seconds
+            card = PerfCard(
+                tag=tag,
+                flops=float(raw.get("flops", 0.0)),
+                bytes_accessed=float(raw.get("bytes_accessed", 0.0)),
+                arg_bytes=int(raw.get("arg_bytes", 0)),
+                out_bytes=int(raw.get("out_bytes", 0)),
+                temp_bytes=int(raw.get("temp_bytes", 0)),
+                code_bytes=int(raw.get("code_bytes", 0)),
+                compile_seconds=float(raw.get("compile_seconds", 0.0)),
+                source=source)
+            card.roofline_s = roofline_seconds(
+                card.flops, card.bytes_accessed,
+                self.peak_flops, self.peak_bytes_per_second)
+            with self._lock:
+                prev = self._cards.get(tag)
+                if prev is not None:
+                    # re-capture (e.g. a fresh life's compile of a tag
+                    # the header already seeded): keep the accrual
+                    card.model, card.bucket = prev.model, prev.bucket
+                    card.layout, card.mode = prev.layout, prev.mode
+                    card.batch = prev.batch
+                    card.dispatches = prev.dispatches
+                    card.real_tasks = prev.real_tasks
+                    card.padded_slots = prev.padded_slots
+                    card.wire_bytes = prev.wire_bytes
+                    card.observed = prev.observed
+                self._cards[tag] = card
+            return card.perf_block()
+        except Exception:  # noqa: BLE001 — capture must never be why a
+            # solve (or a cache publish) fails
+            self._skip("record_executable")
+            return None
+
+    def adopt(self, tag: str | None, fn) -> None:
+        """Memory-tier adoption: a cache hit can still card the bucket
+        when the cached executable is ALREADY compiled (an earlier life
+        under perfscope/AOT compiled it eagerly — the bench warm-pass
+        pattern). A lazy jitted callable yields no analysis and lands
+        in a negative cache, so the hot path pays one set lookup per
+        dispatch after the first attempt — never repeated analysis.
+        `compile_seconds` stays 0 — no compile happened in THIS life,
+        which is exactly what amortization should say."""
+        if tag is None:
+            return
+        with self._lock:
+            if tag in self._cards or tag in self._unanalyzable:
+                return
+        try:
+            analyzed = analyze_executable(fn)
+        except Exception:  # noqa: BLE001 — adoption is best-effort
+            analyzed = {}
+        if not any(analyzed.values()):
+            with self._lock:
+                self._unanalyzable.add(tag)
+            return
+        self.record_executable(tag, fn, source="memory",
+                               _analyzed=analyzed)
+
+    def _skip(self, where: str) -> None:
+        if self._c_skips is not None:
+            self._c_skips.inc()
+        if self.obs is not None:
+            self.obs.event("perf_capture_skip", where=where)
+
+    # -- derived-fact joins ----------------------------------------------
+    def record_collectives(self, tag: str | None,
+                           est: dict[str, int]) -> None:
+        """Per-dispatch collective wire-byte estimate for a bucket —
+        fed by `meshsolve.record_collective_bytes` through the same
+        per-bucket cache the traffic counter uses."""
+        if tag is None or not est:
+            return
+        with self._lock:
+            card = self._cards.get(tag)
+            if card is not None:
+                card.wire_bytes = {k: int(v) for k, v in est.items()}
+
+    def observe_dispatch(self, tag: str | None, *, model: str,
+                         bucket: str, layout: str, mode: str,
+                         batch: int, real: int, padded: int,
+                         seconds: float,
+                         dispatches: int = 1) -> float | None:
+        """One attributed bucket observation: binds the cost key on
+        first sight, accrues dispatch/padding accounting, appends the
+        observed wall, and evaluates the drift band. Returns the drift
+        ratio (None until computable). Called by the node at the same
+        place it observes `arbius_stage_seconds{infer}`, so the card
+        and the cost model read one signal. `seconds` is the WHOLE
+        bucket's infer wall; `dispatches` is how many executable
+        invocations it covered (`chunk_items`' chunk count) — the
+        observed window stores the PER-DISPATCH wall, so the drift
+        ratio compares one program invocation against the card's
+        one-invocation roofline regardless of how full the queue was
+        (and agrees with PERF601's fitted-row check: per-task
+        chip-seconds × batch is also one chunk's wall)."""
+        if tag is None:
+            return None
+        drift = None
+        breach = crossed = False
+        dispatches = max(1, int(dispatches))
+        with self._lock:
+            card = self._cards.get(tag)
+            if card is None:
+                return None
+            card.model, card.bucket = model, bucket
+            card.layout, card.mode = layout, mode
+            card.batch = int(batch)
+            card.dispatches += dispatches
+            card.real_tasks += int(real)
+            card.padded_slots += int(padded)
+            card.observed.append(float(seconds) / dispatches)
+            self._dirty.add(tag)
+            drift = card.drift_ratio()
+            if drift is not None and self.drift_max > 0:
+                breach = not (self.drift_min <= drift <= self.drift_max)
+                was = tag in self._breached
+                crossed = breach != was
+                if breach:
+                    self._breached.add(tag)
+                else:
+                    self._breached.discard(tag)
+        if crossed and breach and self.obs is not None:
+            # journaled on the crossing, not every dispatch — the
+            # flight recorder records the state change, the gauge
+            # carries the live ratio
+            self.obs.event("perf_drift", model=model, bucket=bucket,
+                           layout=layout, mode=mode,
+                           drift_ratio=round(drift, 6),
+                           band=[self.drift_min, self.drift_max])
+        return drift
+
+    # -- views / persistence ---------------------------------------------
+    def cards(self) -> list[PerfCard]:
+        """LIVE card objects (single-threaded callers — tests, a quiet
+        scope). Concurrent readers must use the JSON views below: they
+        serialize UNDER the lock, because `to_json()` iterates the
+        observed deque the dispatch thread appends to."""
+        with self._lock:
+            return [self._cards[t] for t in sorted(self._cards)]
+
+    def card_json_for(self, model: str, bucket: str, layout: str,
+                      mode: str) -> dict | None:
+        """One bound card's JSON by cost key — the /debug/costmodel
+        row join (docs/perfscope.md); serialized under the lock."""
+        with self._lock:
+            for card in self._cards.values():
+                if (card.model, card.bucket, card.layout, card.mode) == \
+                        (model, bucket, layout, mode):
+                    return card.to_json()
+        return None
+
+    def snapshot(self) -> dict:
+        """JSON-able view for GET /debug/costmodel and bench lines
+        (serialized under the lock — request threads call this while
+        the tick thread accrues)."""
+        with self._lock:
+            cards = [self._cards[t].to_json() for t in sorted(self._cards)]
+        return {"peak_flops": self.peak_flops,
+                "peak_bytes_per_second": self.peak_bytes_per_second,
+                "drift_band": [self.drift_min, self.drift_max],
+                "cards": cards}
+
+    def dirty_rows(self, now: int = 0) -> list[tuple]:
+        """Bound cards touched since the last call, as `perf_cards`
+        sqlite rows (model, bucket, layout, mode, card_json, updated) —
+        the node persists them inside the tick's batch window
+        (docs/perfscope.md), so cards cost no extra fsync."""
+        rows = []
+        with self._lock:
+            for tag in sorted(self._dirty):
+                card = self._cards.get(tag)
+                if card is None or not card.bound:
+                    continue
+                rows.append((card.model, card.bucket, card.layout,
+                             card.mode,
+                             json.dumps(card.to_json(), sort_keys=True),
+                             int(now)))
+            self._dirty.clear()
+        return rows
+
+
+# -- Chrome/Perfetto trace export -------------------------------------------
+#
+# The journal already holds everything a trace viewer needs: span events
+# with span_id/parent_id/wall_start/wall_s, plus the non-span lifecycle
+# events (pipeline_stage, gate_decision, lease_hop, ...). chrome_trace
+# lays them out on the Trace Event Format (the JSON Perfetto and
+# chrome://tracing both load): one process row per fleet member, one
+# thread row per span TREE (= one task lifecycle / one tick batch), "X"
+# complete events for spans and "i" instants for everything else.
+# Pure in (events) — byte-deterministic for a fixed journal, pinned by
+# a tier-1 golden (tests/fixtures/perfscope/).
+
+def _span_roots(spans: list[dict]) -> dict[int, int]:
+    """span_id -> root span_id of its tree (per member, ids are
+    member-local)."""
+    by_id = {e["span_id"]: e for e in spans}
+    roots: dict[int, int] = {}
+
+    def root_of(sid: int) -> int:
+        seen = []
+        cur = sid
+        while True:
+            if cur in roots:
+                r = roots[cur]
+                break
+            seen.append(cur)
+            parent = by_id.get(cur, {}).get("parent_id")
+            if parent is None or parent not in by_id or parent in seen:
+                r = cur
+                break
+            cur = parent
+        for s in seen:
+            roots[s] = r
+        return r
+
+    for e in spans:
+        root_of(e["span_id"])
+    return roots
+
+
+def chrome_trace(events: list[dict]) -> dict:
+    """Journal events → a Trace Event Format document. Fleet-merged
+    events (fleetscope `merge_journals` adds a `member` field) land one
+    process per member; a single node's journal is process
+    "node". Timestamps are microseconds relative to the earliest wall
+    stamp in the corpus, so the document is pure in the events."""
+    members = sorted({e.get("member", "node") for e in events})
+    pid_of = {m: i for i, m in enumerate(members)}
+    walls = [e.get("wall_start", e.get("wall"))
+             for e in events
+             if e.get("wall_start", e.get("wall")) is not None]
+    base = min(walls) if walls else 0.0
+
+    def us(wall) -> int:
+        return int(round((wall - base) * 1e6))
+
+    trace: list[dict] = []
+    for m in members:
+        trace.append({"ph": "M", "pid": pid_of[m], "tid": 0,
+                      "name": "process_name", "args": {"name": m}})
+    by_member_spans = {
+        m: [e for e in events
+            if e.get("member", "node") == m and e.get("kind") == "span"
+            and "span_id" in e]
+        for m in members}
+    roots = {m: _span_roots(sp) for m, sp in by_member_spans.items()}
+    # a non-span event that names a task lands on that task's span-tree
+    # thread, so lifecycle markers (pipeline_stage, gate_decision,
+    # lease_hop) sit inline with the spans that did the work
+    task_tid: dict[tuple, int] = {}
+    for m, spans in by_member_spans.items():
+        for e in spans:
+            tid = roots[m][e["span_id"]]
+            for t in [e.get("taskid")] + list(e.get("taskids") or ()):
+                if t is not None:
+                    task_tid.setdefault((m, t), tid)
+    for e in events:
+        m = e.get("member", "node")
+        pid = pid_of[m]
+        if e.get("kind") == "span" and "span_id" in e:
+            args = {k: v for k, v in e.items()
+                    if k in ("taskid", "taskids", "status", "error",
+                             "chain_start", "chain_end", "attrs", "seq")}
+            trace.append({
+                "ph": "X", "pid": pid,
+                "tid": roots[m][e["span_id"]],
+                "ts": us(e.get("wall_start", base)),
+                "dur": max(1, int(round(e.get("wall_s", 0.0) * 1e6))),
+                "name": e.get("name", "span"), "cat": "span",
+                "args": args})
+        else:
+            args = {k: v for k, v in e.items()
+                    if k not in ("kind", "wall", "member")}
+            trace.append({
+                "ph": "i", "pid": pid,
+                "tid": task_tid.get((m, e.get("taskid")), 0),
+                "ts": us(e.get("wall", base)),
+                "s": "t",
+                "name": e.get("kind", "event"), "cat": "journal",
+                "args": args})
+    # metadata first, then (pid, ts, tid, name): a stable total order
+    # regardless of the input's interleaving
+    trace.sort(key=lambda ev: (ev["ph"] != "M", ev["pid"],
+                               ev.get("ts", -1), ev["tid"],
+                               ev["name"]))
+    return {"displayTimeUnit": "ms", "traceEvents": trace}
+
+
+def render_chrome_trace(events: list[dict]) -> str:
+    """The byte-deterministic serialization the CLI emits and the
+    tier-1 golden pins: sorted keys, fixed indent."""
+    return json.dumps(chrome_trace(events), indent=1, sort_keys=True,
+                      default=str) + "\n"
+
+
+__all__ = [
+    "OBSERVED_WINDOW", "PerfCard", "PerfScope", "analyze_executable",
+    "chrome_trace", "render_chrome_trace", "roofline_seconds",
+]

@@ -283,7 +283,8 @@ def estimate_collective_bytes(mesh, out_shape, out_dtype, params=None,
 
 
 def record_bucket_estimate(cache: dict, bucket_key, mesh, out, batch: int,
-                           *, params=None, wire_dtype=None) -> None:
+                           *, params=None, wire_dtype=None,
+                           tag: str | None = None) -> None:
     """Record one dispatch's traffic, estimating at most once per bucket:
     the estimate is pure in (mesh, bucket shape, param placement), so the
     first dispatch of a bucket walks the param tree and later dispatches
@@ -292,7 +293,11 @@ def record_bucket_estimate(cache: dict, bucket_key, mesh, out, batch: int,
     from the same `batch_specs` decision the bucket compiled with, so a
     replicated-degrade bucket is not charged dp/sp gathers that never
     cross chips. `wire_dtype` rides through to the tp term for
-    quantized-collective buckets (see estimate_collective_bytes)."""
+    quantized-collective buckets (see estimate_collective_bytes).
+    `tag` is the bucket's executable-cache tag: when a `PerfScope` is
+    installed (docs/perfscope.md), the per-dispatch wire bytes join the
+    bucket's PerfCard through it — the same per-bucket cache, no second
+    walk."""
     if mesh is None:
         return
     est = cache.get(bucket_key)
@@ -302,14 +307,16 @@ def record_bucket_estimate(cache: dict, bucket_key, mesh, out, batch: int,
                                         params=params, batch_sharded=sharded,
                                         wire_dtype=wire_dtype)
         cache[bucket_key] = est
-    record_collective_bytes(est)
+    record_collective_bytes(est, tag=tag)
 
 
-def record_collective_bytes(est: dict[str, int]) -> None:
+def record_collective_bytes(est: dict[str, int],
+                            tag: str | None = None) -> None:
     """Add one dispatch's estimated traffic to
     `arbius_collective_bytes_total{axis}` in the ambient obs registry
     (no-op outside a node context — library code stays node-free, the
-    same pattern as `obs.span`)."""
+    same pattern as `obs.span`). `tag` additionally lands the estimate
+    on the bucket's PerfCard when a perfscope is installed."""
     if not est:
         return
     from arbius_tpu.obs import current_obs
@@ -321,6 +328,8 @@ def record_collective_bytes(est: dict[str, int]) -> None:
                              _OBS_HELP_BYTES, labelnames=("axis",))
     for axis, n in est.items():
         c.inc(float(n), axis=axis)
+    if obs.perfscope is not None:
+        obs.perfscope.record_collectives(tag, est)
 
 
 # -- sharded probe runners --------------------------------------------------
@@ -499,7 +508,7 @@ class ShardedImageProbe(_ProbeBase):
             out = fn(self._params, seeds_dev)
         record_bucket_estimate(self._est, len(items), self.mesh, out,
                                len(items), params=self._params,
-                               wire_dtype=self._wire_dtype())
+                               wire_dtype=self._wire_dtype(), tag=tag)
         return out
 
 
@@ -576,7 +585,7 @@ class ShardedSeqProbe(_ProbeBase):
         with timed_dispatch(warm, tag):
             out = fn(self._params, seeds_dev)
         record_bucket_estimate(self._est, len(items), self.mesh, out,
-                               len(items))
+                               len(items), tag=tag)
         return out
 
 

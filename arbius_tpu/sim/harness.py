@@ -51,7 +51,12 @@ from arbius_tpu.node import (
     NodeDB,
     RegisteredModel,
 )
-from arbius_tpu.node.config import PipelineConfig, PrecisionConfig, SchedConfig
+from arbius_tpu.node.config import (
+    PerfscopeConfig,
+    PipelineConfig,
+    PrecisionConfig,
+    SchedConfig,
+)
 from arbius_tpu.node.solver import EVIL_CID
 from arbius_tpu.obs import use_obs
 from arbius_tpu.sim.clock import VirtualClock
@@ -153,7 +158,8 @@ class SimHarness:
                  pipeline: bool = True,
                  mesh: dict | None = None,
                  witness: bool = False,
-                 precision: str = "bf16"):
+                 precision: str = "bf16",
+                 perfscope: bool = False):
         if scenario.faults.crash_after_commit is not None \
                 and db_path == ":memory:":
             # a restart from :memory: builds an EMPTY NodeDB — the run
@@ -169,6 +175,10 @@ class SimHarness:
         self.db_path = db_path
         self.node_cls = node_cls
         self.pipeline = pipeline
+        # perfscope card capture (docs/perfscope.md): metering-only —
+        # cards must not perturb CIDs, so every scenario must hold its
+        # invariants (and its bytes) perfscope-on (test-pinned)
+        self.perfscope = perfscope
         # conclint runtime witness (docs/concurrency.md): instrumented
         # lock wrappers + watched-attr sampling on every node this
         # harness spawns. Bookkeeping-only — CIDs must stay
@@ -306,7 +316,9 @@ class SimHarness:
             # chunking is identical and only the layout differs
             mesh=dict(self.mesh_cfg) if self.mesh_cfg else None,
             canonical_batch=2 if self.mesh_cfg is not None else 1,
-            precision=PrecisionConfig(default=self.precision))
+            precision=PrecisionConfig(default=self.precision),
+            perfscope=PerfscopeConfig(enabled=True)
+            if self.perfscope else PerfscopeConfig())
         self.result.pipeline_enabled = self.pipeline
         if self.mesh_cfg is not None:
             from arbius_tpu.parallel.meshsolve import ShardedImageProbe
@@ -493,7 +505,8 @@ def run_scenario(scenario: Scenario, seed: int, *,
                  pipeline: bool = True,
                  mesh: dict | None = None,
                  witness: bool = False,
-                 precision: str = "bf16") -> SimResult:
+                 precision: str = "bf16",
+                 perfscope: bool = False) -> SimResult:
     """Build a world, drive the scenario to quiescence, return the
     auditable result. `node_cls` lets regression tests inject a
     deliberately buggy node (tests/test_sim.py double-commit);
@@ -505,8 +518,11 @@ def run_scenario(scenario: Scenario, seed: int, *,
     the node with the conclint runtime witness and attaches its report
     to the result for SIM110 (docs/concurrency.md). `precision` runs
     the solves at a quantized mode through the probe runner
-    (docs/quantization.md) — every SIM invariant must hold unchanged."""
+    (docs/quantization.md) — every SIM invariant must hold unchanged.
+    `perfscope=True` installs the perf-card capture (docs/perfscope.md);
+    cards are metering only, so CIDs must match a perfscope-off run
+    byte for byte (test-pinned)."""
     return SimHarness(scenario, seed, db_path=db_path,
                       node_cls=node_cls, pipeline=pipeline,
                       mesh=mesh, witness=witness,
-                      precision=precision).run()
+                      precision=precision, perfscope=perfscope).run()

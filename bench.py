@@ -344,6 +344,34 @@ def _emit(out_path: str, line: dict) -> None:
     _note(f"result: {json.dumps(line)}")
 
 
+def _perf_cards(node) -> list | None:
+    """PerfCard snapshots for a bench mode block (docs/perfscope.md):
+    flops/bytes/padding/roofline context next to the sol/h numbers —
+    None when the node ran without perfscope."""
+    scope = node.obs.perfscope
+    return scope.snapshot()["cards"] if scope is not None else None
+
+
+def _write_bench_r14(stage: str, platform: str, line: dict) -> None:
+    """Merge one stage's perfscope-annotated line into BENCH_r14.json —
+    the round-14 record: the same stage lines as their historic round
+    files, now carrying PerfCard snapshots per mode/layout."""
+    path = os.path.join(_REPO, "BENCH_r14.json")
+    doc = {"ok": True, "round": 14, "stages": {}}
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        if isinstance(prev.get("stages"), dict):
+            doc["stages"] = prev["stages"]
+    except (OSError, ValueError):
+        pass
+    doc["stages"][stage] = {"platform": platform, "result": line}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    _note(f"{stage}: merged into BENCH_r14.json")
+
+
 def _arm_exit_watchdog(grace_s: float = 90.0, code: int = 0) -> None:
     """Shared teardown watchdog (arbius_tpu/utils/session.py) — a
     child's teardown on a wedged tunnel sat ~1500 s after its last
@@ -684,7 +712,7 @@ def _stage_sched_ab(out_path: str) -> None:
         RegisteredModel,
         SD15Runner,
     )
-    from arbius_tpu.node.config import SchedConfig
+    from arbius_tpu.node.config import PerfscopeConfig, SchedConfig
     from arbius_tpu.node.costmodel import CostModel
     from arbius_tpu.templates.engine import load_template
     from arbius_tpu.node.factory import tiny_byte_tokenizer
@@ -726,7 +754,8 @@ def _stage_sched_ab(out_path: str) -> None:
                                  ModelConfig(id=mid_l,
                                              template="anythingv3")),
                          canonical_batch=1, compile_cache_dir=None,
-                         min_fee_per_second=RATE, sched=sched_cfg),
+                         min_fee_per_second=RATE, sched=sched_cfg,
+                         perfscope=PerfscopeConfig(enabled=True)),
             registry)
         node.boot(skip_self_test=True)
         while node.tick():
@@ -836,6 +865,10 @@ def _stage_sched_ab(out_path: str) -> None:
                     for p, q in (("p50", 0.5), ("p95", 0.95),
                                  ("p99", 0.99))},
             },
+            # perfscope cards (docs/perfscope.md): flops/bytes/
+            # padding/roofline context per bucket, joined on the cost
+            # tag — the perf trajectory finally carries the statics
+            "perf_cards": _perf_cards(node),
             "cids": {"0x" + t.hex(): "0x" + s.cid.hex()
                      for t, s in eng.solutions.items()},
         }
@@ -892,6 +925,7 @@ def _stage_sched_ab(out_path: str) -> None:
                    "result": line}, f, indent=1)
         f.write("\n")
     _note("sched_ab: wrote BENCH_r07.json")
+    _write_bench_r14("sched_ab", platform, line)
     hb.stop()
     os._exit(0)
 
@@ -988,6 +1022,7 @@ def _stage_quant_ab(out_path: str) -> None:
     from arbius_tpu.node import LocalChain, MinerNode, MiningConfig, ModelConfig
     from arbius_tpu.node.config import (
         AotCacheConfig,
+        PerfscopeConfig,
         PipelineConfig,
         PrecisionConfig,
     )
@@ -1011,6 +1046,7 @@ def _stage_quant_ab(out_path: str) -> None:
             models=(ModelConfig(id=mid, template="anythingv3", tiny=True),),
             canonical_batch=BATCH, compile_cache_dir=None, mesh=mesh_cfg,
             precision=PrecisionConfig(default=mode),
+            perfscope=PerfscopeConfig(enabled=True),
             aot_cache=AotCacheConfig(enabled=True, dir=aot_dir)
             if aot_dir else AotCacheConfig(),
             pipeline=PipelineConfig(enabled=True, depth=2,
@@ -1055,6 +1091,8 @@ def _stage_quant_ab(out_path: str) -> None:
                     "arbius_jit_cache_hits_total",
                     labelnames=("tier",)).value(tier="disk"),
             },
+            # per-(mode, layout) perfscope cards (docs/perfscope.md)
+            "perf_cards": _perf_cards(node),
             "cids": sorted("0x" + s.cid.hex()
                            for s in eng.solutions.values()),
         }
@@ -1144,6 +1182,7 @@ def _stage_quant_ab(out_path: str) -> None:
                    "platform": platform, "result": line}, f, indent=1)
         f.write("\n")
     _note("quant_ab: wrote BENCH_r13.json")
+    _write_bench_r14("quant_ab", platform, line)
     hb.stop()
     os._exit(0)
 
@@ -1184,7 +1223,7 @@ def _stage_coldboot(out_path: str) -> None:
         RegisteredModel,
         SD15Runner,
     )
-    from arbius_tpu.node.config import AotCacheConfig
+    from arbius_tpu.node.config import AotCacheConfig, PerfscopeConfig
     from arbius_tpu.node.factory import tiny_byte_tokenizer
     from arbius_tpu.templates.engine import load_template
 
@@ -1227,7 +1266,8 @@ def _stage_coldboot(out_path: str) -> None:
                                              template="anythingv3"),),
                          canonical_batch=1, compile_cache_dir=None,
                          aot_cache=AotCacheConfig(enabled=True,
-                                                  dir=cache_dir)),
+                                                  dir=cache_dir),
+                         perfscope=PerfscopeConfig(enabled=True)),
             registry)
         t0 = time.perf_counter()
         node.boot(skip_self_test=True)
@@ -1283,6 +1323,10 @@ def _stage_coldboot(out_path: str) -> None:
                     "arbius_jit_cache_misses_total").value(),
             },
             "disk_warm_at_boot": sorted(node._disk_warm_tags),
+            # cards on BOTH lives: the warm one must carry the
+            # ORIGINAL compile cost from the aotcache header's perf
+            # block (source=disk — docs/perfscope.md amortization)
+            "perf_cards": _perf_cards(node),
             "cids": {"0x" + t.hex(): "0x" + s.cid.hex()
                      for t, s in eng.solutions.items()},
         }
@@ -1341,6 +1385,7 @@ def _stage_coldboot(out_path: str) -> None:
                    "result": line}, f, indent=1)
         f.write("\n")
     _note("coldboot: wrote BENCH_r12.json")
+    _write_bench_r14("coldboot", platform, line)
     hb.stop()
     os._exit(0)
 

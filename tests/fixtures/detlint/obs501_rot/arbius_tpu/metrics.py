@@ -1,0 +1,16 @@
+"""Doc-rot fixture tree: one live literal, one f-string family.
+
+The sibling docs/observability.md documents three names: the literal
+registered below (alive), a member of the f-string family below (alive
+via the family honesty bound), and a ghost whose name appears nowhere
+in this tree — the rot the golden pins. (Any textual occurrence counts
+as alive, so the ghost's name must not be spelled even here.)
+"""
+
+
+def register(reg):
+    # detlint: allow[OBS501] fixture metric documented in the FIXTURE doc,
+    # not the repo doc (this tree exercises the rot direction only)
+    reg.counter("arbius_fixture_live_total", "still registered").inc()
+    for name in ("a", "b"):
+        reg.counter(f"arbius_fixture_roted_{name}_total", "family").inc()

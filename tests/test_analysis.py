@@ -486,6 +486,56 @@ def test_obs501_fixture_golden_json():
     assert not any("waived" in f["snippet"] for f in doc["findings"])
 
 
+def test_obs501_doc_rot_fixture_golden_json():
+    """The rot direction (doc → code): the fixture tree documents three
+    names — a live literal, an f-string family member (absolved by the
+    family honesty bound), and a ghost. Exactly the ghost flags,
+    anchored on the DOC line, pinned byte-for-byte."""
+    fixroot = FIXDIR / "obs501_rot"
+    got = _json_report([str(fixroot / "arbius_tpu")], str(fixroot))
+    want = (FIXDIR / "obs501_rot.golden.json").read_text()
+    assert got == want
+    doc = json.loads(got)
+    (finding,) = doc["findings"]
+    assert finding["rule"] == "OBS501"
+    assert finding["path"] == "docs/observability.md"
+    assert "arbius_fixture_ghost_depth" in finding["message"]
+    assert "doc rot" in finding["message"]
+
+
+def test_obs501_doc_rot_only_fires_on_whole_package_scans():
+    """A single-file run sees only a slice of the tree — every doc row
+    would look rotten. The rot direction requires a directory named
+    arbius_tpu among the inputs."""
+    from arbius_tpu.analysis.core import analyze_paths
+
+    fixroot = FIXDIR / "obs501_rot"
+    partial = analyze_paths(
+        [str(fixroot / "arbius_tpu" / "metrics.py")], root=str(fixroot))
+    assert not any(f.path.startswith("docs/") for f in partial)
+    full = analyze_paths([str(fixroot / "arbius_tpu")],
+                         root=str(fixroot))
+    assert any(f.path == "docs/observability.md" for f in full)
+    # a SUPERSET scan (the root containing arbius_tpu/) covers the
+    # whole package too — the rot direction must not silently skip it
+    superset = analyze_paths([str(fixroot)], root=str(fixroot))
+    assert any(f.path == "docs/observability.md" for f in superset)
+
+
+def test_obs501_doc_rot_respects_select():
+    """--select gates the rot direction like any rule. (The real tree's
+    cleanliness is already enforced by the tier-1 whole-tree self-check
+    — a rot finding cannot be baselined away into it silently.)"""
+    from arbius_tpu.analysis.core import analyze_paths
+
+    fixroot = FIXDIR / "obs501_rot"
+    rot = analyze_paths([str(fixroot / "arbius_tpu")],
+                        root=str(fixroot), select={"OBS501"})
+    assert [f.path for f in rot] == ["docs/observability.md"]
+    assert not analyze_paths([str(fixroot / "arbius_tpu")],
+                             root=str(fixroot), select={"DET101"})
+
+
 # -- suppressions, enforce, LINT001 -----------------------------------------
 
 def test_inline_suppression_same_line_and_above():

@@ -70,6 +70,52 @@ def test_histogram_estimate_percentile_not_window_truncated():
     assert h.bucket_counts() == [100, 100, 0]
 
 
+def test_histogram_estimate_percentile_edge_cases():
+    """Pin the Prometheus-histogram_quantile answers the SLO math
+    relies on, per edge case, on the Histogram class itself:
+
+      * empty histogram        → None (no data is not 0.0)
+      * all mass in the FIRST bucket → interpolation from 0.0 (the
+        implicit lower bound) to the first edge
+      * all mass in the +Inf overflow bucket → clamps to the top
+        finite edge (never extrapolates past what the edges know)
+      * a single observation   → that sample's whole bucket answers
+        every quantile (rank 1 of 1 lands there for any q)
+    """
+    def fresh():
+        return MetricsRegistry().histogram("h", buckets=(1.0, 2.0, 4.0))
+
+    # empty
+    h = fresh()
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.estimate_percentile(q) is None
+    # all mass in the first bucket: linear from 0.0 up to edge 1.0
+    h = fresh()
+    for _ in range(10):
+        h.observe(0.7)
+    assert h.estimate_percentile(0.5) == pytest.approx(0.5)
+    assert h.estimate_percentile(1.0) == pytest.approx(1.0)
+    assert h.estimate_percentile(0.0) == pytest.approx(0.0)
+    # all mass in the overflow bucket: clamp to the top finite edge
+    h = fresh()
+    for _ in range(7):
+        h.observe(100.0)
+    for q in (0.01, 0.5, 0.99):
+        assert h.estimate_percentile(q) == 4.0
+    # single observation: its bucket answers every quantile
+    h = fresh()
+    h.observe(3.0)  # lands in (2, 4]
+    assert h.estimate_percentile(0.0) == pytest.approx(2.0)
+    assert h.estimate_percentile(0.5) == pytest.approx(3.0)
+    assert h.estimate_percentile(1.0) == pytest.approx(4.0)
+    # labeled child with no samples behaves like empty (and must not
+    # materialize a series — the _peek contract)
+    reg = MetricsRegistry()
+    hl = reg.histogram("hl", buckets=(1.0,), labelnames=("stage",))
+    assert hl.estimate_percentile(0.5, stage="infer") is None
+    assert hl.bucket_counts(stage="infer") == [0, 0]
+
+
 def test_merge_bucket_counts_rejects_mismatched_edges():
     with pytest.raises(ValueError, match="mismatched bucket edges"):
         merge_bucket_counts((1.0, 2.0), [1, 0, 0],

@@ -32,14 +32,26 @@ from arbius_tpu.node.costmodel import CostModel  # noqa: E402 (_common fixes pat
 
 
 def render_rows(rows: list[dict]) -> str:
-    """Fixed-format deterministic table, one line per fitted row."""
+    """Fixed-format deterministic table, one line per fitted row. Rows
+    that joined a perf card (docs/perfscope.md) grow the static-fact
+    columns; card-less snapshots render the historic table byte for
+    byte (the tier-1 fixtures pin that)."""
     if not rows:
         return "(no fitted rows)"
     head = {"model": "model", "bucket": "bucket", "layout": "layout",
             "mode": "mode", "chip_seconds": "chip_seconds",
-            "samples": "samples", "updated": "updated"}
+            "samples": "samples", "updated": "updated",
+            "flops": "flops", "drift_ratio": "drift_ratio",
+            "utilization": "utilization"}
     cols = ["model", "bucket", "layout", "mode", "chip_seconds",
             "samples", "updated"]
+    if any("flops" in r for r in rows):
+        cols += ["flops", "drift_ratio", "utilization"]
+        for r in rows:
+            for c in ("flops", "drift_ratio", "utilization"):
+                r.setdefault(c, "-")
+                if r[c] is None:
+                    r[c] = "-"
 
     def cell(row, c):
         v = row[c]
@@ -54,15 +66,36 @@ def render_rows(rows: list[dict]) -> str:
 
 
 def load_db_rows(db_path: str) -> list[dict]:
+    """Fitted rows, each joined against its persisted perf card when
+    the db has any (docs/perfscope.md) — flops and utilization next to
+    the learned chip-seconds, through the shared (model, bucket,
+    layout, mode) tag. A card-less db returns the historic row shape
+    untouched."""
     from arbius_tpu.node.costmodel import CostRow
     from arbius_tpu.node.db import NodeDB
 
     db = NodeDB(db_path)
     try:
-        return [CostRow(m, b, l, cs, n, up, mode=md).to_json()
+        rows = [CostRow(m, b, l, cs, n, up, mode=md).to_json()
                 for m, b, l, md, cs, n, up in db.load_cost_rows()]
+        cards = {(m, b, l, md): card
+                 for m, b, l, md, card, _u in db.load_perf_cards()}
     finally:
         db.close()
+    if cards:
+        for r in rows:
+            card = cards.get((r["model"], r["bucket"], r["layout"],
+                              r["mode"]))
+            if card is None:
+                continue
+            r["flops"] = card.get("flops")
+            r["drift_ratio"] = card.get("drift_ratio")
+            roofline = float(card.get("roofline_seconds") or 0.0)
+            bucket_s = r["chip_seconds"] * max(1, int(card.get("batch")
+                                                      or 1))
+            r["utilization"] = round(roofline / bucket_s, 6) \
+                if roofline > 0 and bucket_s > 0 else None
+    return rows
 
 
 def fit_snapshot(path: str, min_samples: int) -> dict:

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""perfscope — audit perf cards for drift and export Chrome traces.
+
+Two offline views over the perfscope subsystem (docs/perfscope.md):
+
+    python tools/perfscope.py --db miner.db                 # PERF601 audit
+    python tools/perfscope.py --db miner.db --json
+    python tools/perfscope.py --db miner.db --drift-max 3.0
+    python tools/perfscope.py --chrome-trace journal.json   # trace export
+    python tools/perfscope.py --chrome-trace --fleet <sidecar-dir>
+
+**Audit** reads the sqlite `perf_cards` table a node persists (joined
+against its `cost_model` rows through the shared (model, bucket,
+layout, mode) tag) and raises PERF601 when a bucket's drift leaves the
+band — the fail-closed "your price model is lying" signal:
+
+    PERF601  observed infer p50 ÷ static roofline outside
+             [--drift-min, --drift-max] (default 0.5..2.0), for either
+             the card's own observed window or the FITTED cost row
+             re-checked against the card's roofline — a mispriced
+             bucket fails the audit even when its live window looked
+             consistent.
+
+Exit codes follow the shared lint contract (0 clean / 1 findings /
+2 usage), and `--json` emits the same stable findings document every
+linter tool does.
+
+**--chrome-trace** renders an obs journal (`GET /debug/journal`'s
+`{"events": [...]}` JSON, or a bare event list) — or, with `--fleet`,
+the federated fleet timeline including cross-process lease hops — as a
+Chrome/Perfetto `trace.json`: one process per fleet member, one thread
+per span tree (= one task lifecycle), lifecycle events as instants on
+their task's track. Byte-deterministic for a fixed journal
+(tier-1-pinned golden).
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from _common import EXIT_CLEAN, EXIT_USAGE, lint_main
+
+# PERF601 policy band (docs/perfscope.md): a healthy bucket's observed
+# p50 sits within 2x of its roofline ON THE PEAKS THE CARD WAS BUILT
+# WITH; outside it either the roofline peaks are wrong (re-tune the
+# perfscope config) or the price model is lying (the finding)
+DEFAULT_DRIFT_MIN = 0.5
+DEFAULT_DRIFT_MAX = 2.0
+
+
+def audit_cards(db_path: str, drift_min: float, drift_max: float) -> list:
+    """PERF601 findings over a node db's persisted cards + cost rows.
+    Deterministic: rows arrive in primary-key order and findings sort
+    like every lint report."""
+    from arbius_tpu.analysis.core import Finding
+    from arbius_tpu.node.db import NodeDB
+
+    db = NodeDB(db_path)
+    try:
+        cards = db.load_perf_cards()
+        cost = {(m, b, l, md): cs
+                for m, b, l, md, cs, _n, _u in db.load_cost_rows()}
+    finally:
+        db.close()
+    findings = []
+
+    def breach(key: tuple, ratio: float, what: str) -> None:
+        findings.append(Finding(
+            path=db_path, line=0, col=0, rule="PERF601",
+            severity="error",
+            message=(f"{what} drift {ratio:.3f} outside "
+                     f"[{drift_min:g}, {drift_max:g}] for "
+                     f"{key[0]}|{key[1]}|{key[2]}|{key[3]} — the price "
+                     "model and the program's static roofline disagree "
+                     "(docs/perfscope.md)"),
+            snippet="|".join(key)))
+
+    for model, bucket, layout, mode, card, _updated in cards:
+        key = (model, bucket, layout, mode)
+        roofline = float(card.get("roofline_seconds") or 0.0)
+        drift = card.get("drift_ratio")
+        if drift is not None and not (drift_min <= drift <= drift_max):
+            breach(key, float(drift), "observed-window")
+        chip_s = cost.get(key)
+        if chip_s is not None and roofline > 0:
+            # the FITTED row re-checked against the card: per-task
+            # chip-seconds × the card's canonical batch is the bucket
+            # wall the fit claims — a doctored/mispriced row fails
+            # closed even when the live window looked fine
+            batch = max(1, int(card.get("batch") or 1))
+            ratio = (float(chip_s) * batch) / roofline
+            if not (drift_min <= ratio <= drift_max):
+                breach(key, ratio, "fitted-row")
+    findings.sort()
+    return findings
+
+
+def load_journal(path: str) -> list[dict]:
+    """`GET /debug/journal`-shaped `{"events": [...]}` or a bare event
+    list — both are one journal."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        doc = doc.get("events", [])
+    if not isinstance(doc, list):
+        raise ValueError(f"{path}: not a journal (expected a list or "
+                         '{"events": [...]})')
+    return doc
+
+
+def build_arg_parser(p):
+    p.add_argument("--db", help="node sqlite db holding perf_cards + "
+                                "cost_model (audit mode)")
+    p.add_argument("--drift-min", type=float, default=DEFAULT_DRIFT_MIN,
+                   help=f"PERF601 band floor (default {DEFAULT_DRIFT_MIN})")
+    p.add_argument("--drift-max", type=float, default=DEFAULT_DRIFT_MAX,
+                   help=f"PERF601 band ceiling (default {DEFAULT_DRIFT_MAX})")
+    p.add_argument("--chrome-trace", nargs="?", const=True, default=None,
+                   metavar="JOURNAL",
+                   help="render a journal JSON (or, with --fleet, the "
+                        "federated timeline) as a Chrome/Perfetto "
+                        "trace.json on stdout")
+    p.add_argument("--fleet", metavar="DIR", default=None,
+                   help="fleet.sidecar_dir to federate as the journal "
+                        "source for --chrome-trace")
+    p.add_argument("-o", "--out", default=None,
+                   help="write the trace to a file instead of stdout")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings (audit mode)")
+    return p
+
+
+def collect(ns):
+    if ns.chrome_trace is not None:
+        from arbius_tpu.obs.perfscope import render_chrome_trace
+
+        try:
+            if ns.fleet:
+                from arbius_tpu.obs.fleetscope import federate
+
+                events = federate(ns.fleet)["events"]
+            elif ns.chrome_trace is True:
+                print("--chrome-trace needs a journal file or --fleet "
+                      "<sidecar-dir>", file=sys.stderr)
+                return EXIT_USAGE, []
+            else:
+                events = load_journal(ns.chrome_trace)
+        except (OSError, ValueError) as e:
+            print(f"perfscope: {e}", file=sys.stderr)
+            return EXIT_USAGE, []
+        out = render_chrome_trace(events)
+        if ns.out:
+            with open(ns.out, "w") as f:
+                f.write(out)
+            print(f"perfscope: wrote {ns.out} "
+                  f"({len(events)} event(s))", file=sys.stderr)
+        else:
+            sys.stdout.write(out)
+        return EXIT_CLEAN, []
+    if not ns.db:
+        print("perfscope: --db <node.sqlite> (audit) or --chrome-trace "
+              "<journal.json> is required", file=sys.stderr)
+        return EXIT_USAGE, []
+    if ns.drift_min < 0 or ns.drift_max < ns.drift_min:
+        print("perfscope: need 0 <= --drift-min <= --drift-max",
+              file=sys.stderr)
+        return EXIT_USAGE, []
+    try:
+        findings = audit_cards(ns.db, ns.drift_min, ns.drift_max)
+    except OSError as e:
+        print(f"perfscope: {e}", file=sys.stderr)
+        return EXIT_USAGE, []
+    return None, findings
+
+
+def render(ns, findings, out):
+    from arbius_tpu.analysis.cli import render_json
+
+    if ns.json:
+        render_json(findings, out)
+        return
+    for f in findings:
+        out.write(f.text() + "\n")
+    out.write(f"perfscope: {len(findings)} finding(s)\n" if findings
+              else "perfscope: cards within the drift band\n")
+
+
+def main(argv=None) -> int:
+    return lint_main("perfscope", __doc__, build_arg_parser, collect,
+                     render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
