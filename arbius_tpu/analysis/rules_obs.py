@@ -14,6 +14,15 @@ PRs). OBS501 closes the loop:
           a deliberate exception takes the usual reason-mandatory
           `# detlint: allow[OBS501] why` pragma.
 
+OBS501 also covers the healthwatch ALERT catalog (docs/healthwatch.md):
+a literal `AlertRule(name="…")` constructor anywhere under
+`arbius_tpu/` must have a matching `alert="<name>"` token in
+docs/observability.md (the Prometheus label notation the alert gauges
+expose), and — in the doc-rot direction below — every documented
+`alert="…"` token must still occur as a word in the scanned sources
+(the catalog defines rule ids as string literals, so any occurrence
+counts as alive; same honesty bound as metrics).
+
 The rule also runs the OTHER direction — doc rot: when a whole-package
 scan covers `arbius_tpu/` (analyze_tree detects a directory named
 `arbius_tpu` among its inputs), every `arbius_*` token in
@@ -47,6 +56,11 @@ from arbius_tpu.analysis.core import FileContext, rule
 
 _REGISTRY_METHODS = ("counter", "gauge", "histogram")
 _TOKEN = re.compile(r"\barbius_[a-z0-9_]+\b")
+# healthwatch alert rows (docs/healthwatch.md): documented in the
+# Prometheus label notation the gauges actually expose —
+# `arbius_alert_state{alert="stuck_tick"}` — so the doc token set is
+# the `alert="<name>"` occurrences
+_ALERT_TOKEN = re.compile(r'alert="([a-z0-9_]+)"')
 
 # repo root resolved from this module (arbius_tpu/analysis/rules_obs.py)
 _DOC_PATH = os.path.join(
@@ -55,6 +69,22 @@ _DOC_PATH = os.path.join(
     "docs", "observability.md")
 
 _documented: dict[str, set[str]] = {}
+_documented_alerts: dict[str, set[str]] = {}
+
+
+def documented_alert_names(path: str = _DOC_PATH) -> set[str]:
+    """Every `alert="<name>"` token in docs/observability.md — the
+    healthwatch catalog's doc contract (same caching/fail-closed
+    posture as documented_metric_names)."""
+    cached = _documented_alerts.get(path)
+    if cached is None:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                cached = set(_ALERT_TOKEN.findall(fh.read()))
+        except OSError:
+            cached = set()
+        _documented_alerts[path] = cached
+    return cached
 
 
 def documented_metric_names(path: str = _DOC_PATH) -> set[str]:
@@ -83,6 +113,15 @@ def _literal_name(call: ast.Call) -> ast.Constant | None:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node
     return None
+
+
+def _is_alert_rule_call(call: ast.Call) -> bool:
+    """`AlertRule(...)` by bare name or attribute — the one constructor
+    shape the healthwatch catalog uses (obs/healthwatch.py)."""
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else \
+        fn.attr if isinstance(fn, ast.Attribute) else None
+    return name == "AlertRule"
 
 
 # f-string metric families in source text: `f"arbius_{name}_total"` —
@@ -115,8 +154,14 @@ def doc_rot_findings(root: str, sources: dict[str, str]) -> list:
     except OSError:
         return []  # no doc in this tree = no contract to rot
     alive: set[str] = set()
+    # one pass over the sources for the alert direction too: maximal
+    # word runs, so membership of a whole alert name is exactly what
+    # a \b<name>\b search would find (a name embedded in a larger
+    # word is neither matched nor in this set)
+    alive_words: set[str] = set()
     for src in sources.values():
         alive.update(_TOKEN.findall(src))
+        alive_words.update(re.findall(r"[A-Za-z0-9_]+", src))
     patterns = _family_patterns(sources)
     findings = []
     seen: set[str] = set()
@@ -134,6 +179,25 @@ def doc_rot_findings(root: str, sources: dict[str, str]) -> list:
                          "rot; delete it (or restore the metric): the "
                          "operator doc is a contract, not a suggestion"),
                 snippet=line.strip()))
+        for token in _ALERT_TOKEN.findall(line):
+            # the alert rot direction (docs/healthwatch.md): a
+            # documented `alert="<name>"` row must still name a rule
+            # somewhere in the scanned sources (the catalog defines
+            # rule ids as string literals, so any word occurrence
+            # counts as alive — the same honesty bound as metrics)
+            key = f"alert:{token}"
+            if key in seen or token in alive_words:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                path="docs/observability.md", line=lineno, col=0,
+                rule="OBS501", severity="error",
+                message=(f"documented alert `{token}` no longer occurs "
+                         "anywhere in the scanned tree — the catalog "
+                         "rule was removed or renamed; delete the row "
+                         "(or restore the rule): the operator doc is "
+                         "a contract, not a suggestion"),
+                snippet=line.strip()))
     return findings
 
 
@@ -146,8 +210,24 @@ def undocumented_metric(ctx: FileContext):
         return
     documented = documented_metric_names()
     for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_alert_rule_call(node):
+            # the healthwatch alert direction (docs/healthwatch.md):
+            # every catalog rule id must have an `alert="<name>"` row
+            # in docs/observability.md — an alert an operator cannot
+            # look up is doc drift exactly like an undocumented metric
+            name = _literal_name(node)
+            if name is not None and \
+                    name.value not in documented_alert_names():
+                yield (node.lineno, node.col_offset,
+                       f"alert rule `{name.value}` is in the catalog "
+                       "here but has no `alert=\"…\"` row in "
+                       "docs/observability.md — add the row (or "
+                       "rename); the operator doc is a contract, not "
+                       "a suggestion")
+            continue
+        if not (isinstance(node.func, ast.Attribute)
                 and node.func.attr in _REGISTRY_METHODS):
             continue
         name = _literal_name(node)

@@ -54,6 +54,12 @@ class LeaseFeed:
         self._sidecar = None
         self._flush_every = 1
         self._pumps = 0
+        # healthwatch lease_starvation signal (docs/healthwatch.md):
+        # True when the last pump had backlog room but acquired
+        # nothing while the table held pending leases — computed only
+        # when the node runs an alert engine (the pending-count query
+        # must cost the flood soak nothing)
+        self.starved = False
 
     def attach(self, node) -> "LeaseFeed":
         """Wire this feed into `node` (before boot): the node stops
@@ -85,11 +91,21 @@ class LeaseFeed:
         backlog = node.db.count_jobs(_BACKLOG_METHODS)
         room = min(cfg.max_leases, cfg.backlog - backlog)
         if room <= 0:
+            self.starved = False   # no room ≠ starved: we are FULL
             return 0
+        # pending is read BEFORE acquire: a lease dealt in the gap is
+        # then simply acquired (grants non-empty → not starved); read
+        # after, it would mark a pump starved for work it never had a
+        # chance at. Only computed when an alert engine is watching —
+        # the count query must cost the flood soak nothing.
+        pending = self.leases.counts().get("pending", 0) \
+            if getattr(node, "healthwatch", None) is not None else 0
         queued = 0
-        for grant in self.leases.acquire(self.worker_id, now,
-                                         cfg.lease_ttl, room):
+        grants = list(self.leases.acquire(self.worker_id, now,
+                                          cfg.lease_ttl, room))
+        for grant in grants:
             queued += self._ingest(node, grant, now)
+        self.starved = not grants and pending > 0
         self._pumps += 1
         if self._sidecar is not None and \
                 self._pumps % self._flush_every == 0:

@@ -256,6 +256,67 @@ class PerfscopeConfig:
 
 
 @dataclass(frozen=True)
+class AlertsConfig:
+    """Live alert engine (docs/healthwatch.md): a catalog of named
+    alert rules — each an ok → pending → firing → resolved state
+    machine with hysteresis — evaluated once per node tick over the
+    obs registry, the queue, and the `slo`/`perfscope` config.
+    Chain/virtual time only, so the transition history is
+    deterministic for a given tick history.
+
+    Disabled by default — `enabled: false` IS the pre-healthwatch node
+    bit-for-bit (no evaluation, no gauges). Enabling never perturbs a
+    solve: the engine is bookkeeping-only and CIDs are pinned
+    identical on vs off (tests/test_healthwatch.py)."""
+    enabled: bool = False
+    # consecutive active evaluations before a sustained-signal rule
+    # fires (the pending window); instantaneous rules use 1
+    for_ticks: int = 3
+    # quiet evaluations a resolved alert holds before returning to ok
+    resolve_ticks: int = 1
+    # chain seconds of due-job starvation before stuck_tick activates
+    stuck_after_seconds: int = 600
+    # evaluations the crash_recovered condition holds after an
+    # unclean-boot detection
+    crash_hold_ticks: int = 3
+    # consecutive gate-reject ticks before unprofitable_streak fires
+    unprofitable_streak: int = 8
+    # pipeline stage stalls per tick before pipeline_stall activates —
+    # bounded-queue backpressure stalls a producer a few times per
+    # tick by DESIGN (docs/pipeline.md); the alert is for a storm
+    stall_burst: int = 8
+    # per-rule for_ticks overrides, e.g. {"rpc_degraded": 5}
+    per_rule: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        from arbius_tpu.obs.healthwatch import RULE_NAMES
+
+        for name, bound in (("for_ticks", self.for_ticks),
+                            ("resolve_ticks", self.resolve_ticks),
+                            ("stuck_after_seconds",
+                             self.stuck_after_seconds),
+                            ("crash_hold_ticks", self.crash_hold_ticks),
+                            ("stall_burst", self.stall_burst),
+                            ("unprofitable_streak",
+                             self.unprofitable_streak)):
+            if not isinstance(bound, int) or bound < 1:
+                raise ConfigError(f"alerts.{name} must be an integer "
+                                  ">= 1")
+        if not isinstance(self.per_rule, dict):
+            raise ConfigError(
+                'alerts.per_rule must be a {rule: for_ticks} object '
+                '(e.g. {"rpc_degraded": 5})')
+        for rule, ticks in self.per_rule.items():
+            if rule not in RULE_NAMES:
+                raise ConfigError(
+                    f"alerts.per_rule names unknown rule {rule!r} — "
+                    f"the catalog is: {', '.join(RULE_NAMES)}")
+            if not isinstance(ticks, int) or ticks < 1:
+                raise ConfigError(f"alerts.per_rule[{rule!r}] must be "
+                                  "an integer >= 1")
+
+
+@dataclass(frozen=True)
 class SLOConfig:
     """First-class service-level objectives over the fleet's chain-time
     latency corpus (docs/fleetscope.md): each threshold declares an
@@ -461,6 +522,9 @@ class MiningConfig:
     # (docs/perfscope.md); default OFF = no capture, the pre-perfscope
     # compile seam bit-for-bit
     perfscope: PerfscopeConfig = PerfscopeConfig()
+    # live alert engine (docs/healthwatch.md); default OFF = no
+    # evaluation, no alert gauges — the pre-healthwatch node
+    alerts: AlertsConfig = AlertsConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -561,9 +625,11 @@ def load_config(raw: str | dict) -> MiningConfig:
                       "precision")
     perfscope = build(PerfscopeConfig, obj.pop("perfscope", {}),
                       "perfscope")
+    alerts = build(AlertsConfig, obj.pop("alerts", {}), "alerts")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
                       ipfs=ipfs, pipeline=pipeline, sched=sched,
                       fleet=fleet, slo=slo, aot_cache=aot_cache,
-                      precision=precision, perfscope=perfscope, **obj),
+                      precision=precision, perfscope=perfscope,
+                      alerts=alerts, **obj),
                  "config")

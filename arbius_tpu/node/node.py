@@ -179,6 +179,24 @@ class MinerNode:
         self.task_feed = None
         self.commit_guard = None
         self.mesh = None          # built + validated at boot (cfg.mesh)
+        # live alert engine (docs/healthwatch.md): installed at
+        # construction — like perfscope — so the reference is
+        # published before any RPC request thread can exist (the
+        # /debug/alerts view reads it). Unclean-shutdown evidence is
+        # read from the checkpoint HERE, before boot clears heartbeats
+        # or any tick queues fresh work: a fresh db holds no jobs, a
+        # checkpoint with in-flight work means the previous life died
+        # mid-mine (the crash_recovered rule). None = no evaluation,
+        # the pre-healthwatch node bit-for-bit.
+        self.healthwatch = None
+        if config.alerts.enabled:
+            from arbius_tpu.obs.healthwatch import HealthWatch
+
+            self.healthwatch = HealthWatch(
+                self.obs, config.alerts, slo=config.slo,
+                recovered=any(
+                    j.method not in ("validatorStake", "automine")
+                    for j in self.db.get_jobs(2**60, limit=50)))
         # AOT executable cache (docs/compile-cache.md), installed at
         # boot when cfg.aot_cache.enabled; the disk-warm tag set feeds
         # costsched's CROSS-LIFE warm boost (published under state_lock
@@ -492,6 +510,15 @@ class MinerNode:
             try:
                 poll()
             except Exception as e:  # noqa: BLE001 — endpoint flake
+                # counted, not just logged: the healthwatch rpc_degraded
+                # rule watches this — a flapping endpoint must be a
+                # first-class signal, not log archaeology
+                # (docs/healthwatch.md)
+                self.obs.registry.counter(
+                    "arbius_event_poll_failures_total",
+                    "Event polls that failed (retried next tick) — a "
+                    "flaky endpoint's first-class signal "
+                    "(docs/healthwatch.md)").inc()
                 log.warning("event poll failed (will retry): %r", e)
         if self.task_feed is not None:
             # fleet worker mode: settle/heartbeat/pull leases before the
@@ -502,7 +529,21 @@ class MinerNode:
             try:
                 self.task_feed.pump(self)
             except Exception as e:  # noqa: BLE001 — lease-db flake
+                self.obs.registry.counter(
+                    "arbius_lease_pump_failures_total",
+                    "Lease pumps that failed (re-pumped next tick) — "
+                    "the fleet worker's lease-plane health signal "
+                    "(docs/healthwatch.md)").inc()
                 log.warning("lease pump failed (will retry): %r", e)
+        done = self._drain_jobs()
+        if self.healthwatch is not None:
+            # one evaluation per tick, AFTER the job cycle so this
+            # tick's counters are judged exactly once; degrades to a
+            # journaled skip internally — never why a tick fails
+            self.healthwatch.evaluate(self, done)
+        return done
+
+    def _drain_jobs(self) -> int:
         jobs = self.db.get_jobs(self.chain.now)
         if not jobs:
             return 0

@@ -569,8 +569,10 @@ class ControlRPC:
 
     def debug_view(self, path: str) -> tuple[int, object]:
         """GET /debug/trace?taskid=0x… → the task's span trees;
-        GET /debug/journal[?limit=N&kind=K] → raw journal events;
-        GET /debug/costmodel → the learned cost table + packer state."""
+        GET /debug/journal[?limit=N&kind=K&taskid=0x…] → raw journal
+        events; GET /debug/costmodel → the learned cost table + packer
+        state; GET /debug/alerts → the healthwatch engine's snapshot
+        (docs/healthwatch.md)."""
         parts = urlsplit(path)
         q = parse_qs(parts.query)
         if parts.path == "/debug/costmodel":
@@ -666,11 +668,27 @@ class ControlRPC:
                 limit = int((q.get("limit") or ["200"])[0])
             except ValueError:
                 return 400, {"error": "limit must be an integer"}
+            # `kind` and `taskid` mirror EventJournal.events() exactly
+            # (taskid matches an event's taskid field or membership in
+            # its taskids list, the /debug/trace semantics); filters
+            # apply BEFORE the limit, order stays journal (seq) order —
+            # test-pinned (tests/test_healthwatch.py)
             kind = (q.get("kind") or [None])[0]
-            events = self.node.obs.journal.events(kind=kind, limit=limit)
+            taskid = (q.get("taskid") or [None])[0]
+            events = self.node.obs.journal.events(kind=kind,
+                                                  taskid=taskid,
+                                                  limit=limit)
             return 200, {"events": events,
                          "capacity": self.node.obs.journal.capacity,
                          "dropped": self.node.obs.journal.dropped}
+        if parts.path == "/debug/alerts":
+            # the healthwatch engine's whole state in one view
+            # (docs/healthwatch.md): per-rule state machine positions,
+            # streaks, transition counts, live detail strings
+            hw = self.node.healthwatch
+            if hw is None:
+                return 200, {"enabled": False, "alerts": []}
+            return 200, hw.snapshot()
         return 404, {"error": "not found"}
 
     def _view_error(self, handler, e: Exception) -> None:

@@ -119,6 +119,14 @@ class RpcChain:
         self._next_block = start_block
         self._task_txhash: dict[str, str] = {}
         self._now: int | None = None
+        # stale-event detection (docs/healthwatch.md): identities of
+        # recently dispatched logs, kept for _STALE_KEEP_BLOCKS behind
+        # the poll cursor — a log at/below the window floor (delayed
+        # delivery, shallow reorg) or duplicated in-window (replay) is
+        # counted into arbius_chain_events_stale_total. Counting only:
+        # dispatch semantics are untouched (handlers keep deduping via
+        # INSERT OR IGNORE), so bytes never depend on this.
+        self._seen_logs: dict[tuple, int] = {}
 
     # -- chain state -------------------------------------------------------
     @property
@@ -145,6 +153,19 @@ class RpcChain:
             "address": self.client.engine_address,
             "fromBlock": hex(self._next_block),
             "toBlock": hex(latest)}])
+        stale = self._count_stale(logs, self._next_block, latest)
+        if stale:
+            from arbius_tpu.obs import current_obs
+
+            obs = current_obs()
+            if obs is not None:
+                obs.registry.counter(
+                    "arbius_chain_events_stale_total",
+                    "Chain events delivered at/below the poll window "
+                    "floor or duplicated in-window — delayed "
+                    "deliveries, replays, shallow reorgs; the "
+                    "healthwatch chain_replay signal "
+                    "(docs/healthwatch.md)").inc(stale)
         n = 0
         for lg in logs:
             ev = self._decode_log(lg)
@@ -161,6 +182,35 @@ class RpcChain:
         # INSERT OR IGNORE) instead of silently dropping events
         self._next_block = latest + 1
         return n
+
+    # blocks of log identities retained for replay detection — deeper
+    # than any shallow reorg this facade is meant to observe
+    _STALE_KEEP_BLOCKS = 64
+
+    def _count_stale(self, logs: list, floor: int, latest: int) -> int:
+        """How many of this poll's logs are STALE: block below the
+        window floor (a delayed/reorg-replayed delivery — the range
+        was already consumed), or an identity this facade already
+        dispatched (an in-window replay, incl. a range re-poll after a
+        subscriber raise). Pure bookkeeping over the log list."""
+        stale = 0
+        for lg in logs:
+            try:
+                block = int(lg.get("blockNumber", "0x0"), 16)
+                ident = (block, lg.get("transactionHash", ""),
+                         tuple(lg.get("topics") or ()),
+                         lg.get("data", ""))
+            except (TypeError, ValueError):
+                continue  # undecodable log: _decode_log's problem
+            if block < floor or ident in self._seen_logs:
+                stale += 1
+            self._seen_logs[ident] = max(
+                block, self._seen_logs.get(ident, 0))
+        cutoff = latest - self._STALE_KEEP_BLOCKS
+        if cutoff > 0:
+            self._seen_logs = {k: b for k, b in self._seen_logs.items()
+                               if b >= cutoff}
+        return stale
 
     def _decode_log(self, lg: dict) -> Event | None:
         spec = _TOPIC_TO_EVENT.get(lg["topics"][0])

@@ -435,6 +435,14 @@ class PerfScope:
                            band=[self.drift_min, self.drift_max])
         return drift
 
+    def breached_tags(self) -> tuple[str, ...]:
+        """Tags currently OUTSIDE the drift band, sorted — the
+        healthwatch perf_drift rule's condition (docs/healthwatch.md):
+        the alert stays active exactly while this set is non-empty,
+        mirroring the once-per-crossing perf_drift journal events."""
+        with self._lock:
+            return tuple(sorted(self._breached))
+
     # -- views / persistence ---------------------------------------------
     def cards(self) -> list[PerfCard]:
         """LIVE card objects (single-threaded callers — tests, a quiet

@@ -131,13 +131,43 @@ class SpanGapWorkerNode(MinerNode):
         self.obs.event = dropping
 
 
+class SilentFaultMinerNode(MinerNode):
+    """A miner whose health monitoring went dark: the healthwatch
+    engine still evaluates (gauges keep moving), but every
+    `alert_transition` journal event is swallowed — the flight
+    recorder shows a node that never raised an alert while the fault
+    plane was actively injecting failures. Work still flows, retries
+    still journal, CIDs land byte-identically, SIM101-112 all hold —
+    the fault is SILENT, which is exactly the condition SIM113's
+    coverage invariant exists to catch: a fault class that raised no
+    mapped alert must fail the run, and fail it ALONE. (The CLI forces
+    a fault-injecting scenario + healthwatch, sim/cli.py.)"""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        real_event = self.obs.event
+
+        def muting(kind: str, **fields) -> None:
+            if kind == "alert_transition":
+                return  # the injected monitoring blackout
+            real_event(kind, **fields)
+
+        self.obs.event = muting
+
+
 INJECTABLE_BUGS = {
     "double-commit": DoubleCommitMinerNode,
     "racy-counter": RacyCounterMinerNode,
     "double-lease": DoubleLeaseWorkerNode,
     "span-gap": SpanGapWorkerNode,
+    "silent-fault": SilentFaultMinerNode,
 }
 
 # bugs that only make sense inside a fleet (the CLI swaps the scenario
 # to a fleet one when needed)
 FLEET_BUGS = ("double-lease", "span-gap")
+
+# bugs that only demonstrate anything under an actively fault-injecting
+# scenario with the healthwatch engine on (the CLI swaps a fault-free
+# scenario for rpc-flap and implies --healthwatch)
+FAULT_BUGS = ("silent-fault",)

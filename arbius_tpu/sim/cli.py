@@ -64,6 +64,13 @@ def build_arg_parser(p: argparse.ArgumentParser | None = None
                         "time_to_commit_p99, steal_lag_p99 — a "
                         "breached objective fails the run (SLO101), "
                         "e.g. --slo time_to_commit_p99=120")
+    p.add_argument("--healthwatch", action="store_true",
+                   help="run the live alert engine on every node "
+                        "(docs/healthwatch.md): SIM113 audits the "
+                        "fault→alert coverage — every injected fault "
+                        "class must raise its mapped alert, clean "
+                        "runs must raise none; implied by "
+                        "--inject-bug silent-fault")
     p.add_argument("--witness", action="store_true",
                    help="instrument the node with the conclint runtime "
                         "witness (docs/concurrency.md): SIM110 audits "
@@ -178,7 +185,7 @@ def collect(ns: argparse.Namespace):
     if ns.seeds < 1:
         print("simsoak: --seeds must be >= 1", file=sys.stderr)
         return EXIT_USAGE, []
-    from arbius_tpu.sim.bugs import FLEET_BUGS
+    from arbius_tpu.sim.bugs import FAULT_BUGS, FLEET_BUGS
 
     if ns.inject_bug in FLEET_BUGS and not any(
             s.fleet is not None for s in scenarios):
@@ -186,6 +193,16 @@ def collect(ns: argparse.Namespace):
         from arbius_tpu.sim.scenario import get_scenario
 
         scenarios = [get_scenario("fleet-race")]
+    if ns.inject_bug in FAULT_BUGS:
+        # a monitoring blackout demonstrates nothing unless faults are
+        # actually being injected for healthwatch to miss
+        from arbius_tpu.sim.scenario import FaultSpec, get_scenario
+
+        if all(s.faults == FaultSpec() for s in scenarios):
+            scenarios = [get_scenario("rpc-flap")]
+    # silent-fault exists to be caught by SIM113 — running it without
+    # the alert engine would test nothing (the racy-counter pattern)
+    healthwatch = ns.healthwatch or ns.inject_bug in FAULT_BUGS
 
     findings = []
     # racy-counter exists to be caught by the witness's SIM110 —
@@ -206,14 +223,16 @@ def collect(ns: argparse.Namespace):
                     os.makedirs(fleet_dir, exist_ok=True)
                     result = run_fleet_scenario(scenario, seed,
                                                 workdir=fleet_dir,
-                                                node_cls=node_cls)
+                                                node_cls=node_cls,
+                                                healthwatch=healthwatch)
                 else:
                     db_path = os.path.join(
                         workdir, f"{scenario.name}-{seed}.sqlite")
                     result = run_scenario(scenario, seed,
                                           db_path=db_path,
                                           node_cls=node_cls,
-                                          witness=witness)
+                                          witness=witness,
+                                          healthwatch=healthwatch)
                 if result.witness_report is not None:
                     reports.append(result.witness_report)
                 run_findings = check_all(result)

@@ -174,6 +174,12 @@ class FaultPlane:
         if self.spec.latency_max > 0:
             lat = self._rng_rpc.randint(0, self.spec.latency_max)
             if lat:
+                # counted like every injected fault: timing-only faults
+                # still mark a run as fault-laden, which is what lets
+                # SIM113 hold "clean scenarios raise no alerts" while
+                # allowing latency-driven pipeline stalls to alert
+                # (docs/healthwatch.md coverage map)
+                self.count("latency")
                 self.clock.advance(lat)
         if method == "eth_getLogs":
             if self._rng_rpc.chance(self.spec.poll_error_rate):
@@ -206,6 +212,7 @@ class FaultPlane:
         if self.spec.runner_slow_seconds > 0:
             slow = self._rng_runner.randint(0, self.spec.runner_slow_seconds)
             if slow:
+                self.count("runner_slow")   # timing-only, see rpc_gate
                 self.clock.advance(slow)
         if self._rng_runner.chance(self.spec.runner_crash_rate):
             self.count("runner_crash")

@@ -106,7 +106,8 @@ class FleetSimHarness(SimHarness):
 
     def __init__(self, scenario: Scenario, seed: int, workdir: str,
                  node_cls: type[MinerNode] = MinerNode,
-                 aot_dir: str | None = None):
+                 aot_dir: str | None = None,
+                 healthwatch: bool = False):
         if scenario.fleet is None:
             raise ValueError(f"scenario {scenario.name!r} has no fleet "
                              "spec — use SimHarness")
@@ -120,7 +121,8 @@ class FleetSimHarness(SimHarness):
         self._ticks = 0
         super().__init__(scenario, seed,
                          db_path=os.path.join(workdir, "worker-0.sqlite"),
-                         node_cls=node_cls, pipeline=False, witness=False)
+                         node_cls=node_cls, pipeline=False,
+                         witness=False, healthwatch=healthwatch)
 
     # -- fleet construction ----------------------------------------------
     def _spawn_node(self) -> None:
@@ -187,7 +189,7 @@ class FleetSimHarness(SimHarness):
                                  tx_guard=tx_guard)
         chain = AuditedRpcChain(client, self.dev.token_address,
                                 self.plane)
-        from arbius_tpu.node.config import AotCacheConfig
+        from arbius_tpu.node.config import AlertsConfig, AotCacheConfig
 
         cfg = MiningConfig(
             db_path=":memory:",  # unused: db object injected below
@@ -199,6 +201,11 @@ class FleetSimHarness(SimHarness):
             pipeline=PipelineConfig(),
             aot_cache=AotCacheConfig(enabled=True, dir=self.aot_dir)
             if self.aot_dir else AotCacheConfig(),
+            # per-member healthwatch (docs/healthwatch.md): every
+            # worker runs its own alert engine; its state gauges ride
+            # the sidecar export, so federate() merges fleet health
+            alerts=AlertsConfig(enabled=True)
+            if self.healthwatch else AlertsConfig(),
             canonical_batch=1)
         if self.aot_dir:
             # real XLA through the shared executable cache: the probe's
@@ -299,13 +306,17 @@ class FleetSimHarness(SimHarness):
 
 def run_fleet_scenario(scenario: Scenario, seed: int, *, workdir: str,
                        node_cls: type[MinerNode] = MinerNode,
-                       aot_dir: str | None = None) -> SimResult:
+                       aot_dir: str | None = None,
+                       healthwatch: bool = False) -> SimResult:
     """One-call front door for fleet scenarios (the fleet analogue of
     harness.run_scenario); `node_cls` injects buggy WORKERS
     (sim/bugs.py double-lease), `aot_dir` shares one AOT executable
-    cache across every worker (docs/compile-cache.md)."""
+    cache across every worker (docs/compile-cache.md), `healthwatch`
+    runs the per-member alert engine and puts the run under SIM113's
+    fault→alert coverage audit (docs/healthwatch.md)."""
     return FleetSimHarness(scenario, seed, workdir,
-                           node_cls=node_cls, aot_dir=aot_dir).run()
+                           node_cls=node_cls, aot_dir=aot_dir,
+                           healthwatch=healthwatch).run()
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,7 @@ from arbius_tpu.node import (
     RegisteredModel,
 )
 from arbius_tpu.node.config import (
+    AlertsConfig,
     PerfscopeConfig,
     PipelineConfig,
     PrecisionConfig,
@@ -144,6 +145,10 @@ class SimResult:
     # may simply have fallen off the ring) and downgrades to its
     # structural checks
     journal_dropped: int = 0
+    # healthwatch alert engine (docs/healthwatch.md) ran on every node
+    # this result audits — SIM113's fault→alert coverage invariant
+    # applies only when True (the engine defaults off, like perfscope)
+    healthwatch_enabled: bool = False
 
     def repro(self) -> str:
         return (f"python -m arbius_tpu.sim --scenario "
@@ -159,7 +164,8 @@ class SimHarness:
                  mesh: dict | None = None,
                  witness: bool = False,
                  precision: str = "bf16",
-                 perfscope: bool = False):
+                 perfscope: bool = False,
+                 healthwatch: bool = False):
         if scenario.faults.crash_after_commit is not None \
                 and db_path == ":memory:":
             # a restart from :memory: builds an EMPTY NodeDB — the run
@@ -179,6 +185,11 @@ class SimHarness:
         # cards must not perturb CIDs, so every scenario must hold its
         # invariants (and its bytes) perfscope-on (test-pinned)
         self.perfscope = perfscope
+        # healthwatch alert engine (docs/healthwatch.md): bookkeeping-
+        # only — CIDs must match a healthwatch-off run byte for byte,
+        # and SIM113 audits the fault→alert coverage of every run that
+        # enables it (the matrix fixture does)
+        self.healthwatch = healthwatch
         # conclint runtime witness (docs/concurrency.md): instrumented
         # lock wrappers + watched-attr sampling on every node this
         # harness spawns. Bookkeeping-only — CIDs must stay
@@ -318,8 +329,11 @@ class SimHarness:
             canonical_batch=2 if self.mesh_cfg is not None else 1,
             precision=PrecisionConfig(default=self.precision),
             perfscope=PerfscopeConfig(enabled=True)
-            if self.perfscope else PerfscopeConfig())
+            if self.perfscope else PerfscopeConfig(),
+            alerts=AlertsConfig(enabled=True)
+            if self.healthwatch else AlertsConfig())
         self.result.pipeline_enabled = self.pipeline
+        self.result.healthwatch_enabled = self.healthwatch
         if self.mesh_cfg is not None:
             from arbius_tpu.parallel.meshsolve import ShardedImageProbe
 
@@ -353,6 +367,7 @@ class SimHarness:
         checkpoint (fresh RpcChain — it re-polls from block 0 and the
         db's INSERT OR IGNORE absorbs the replayed history)."""
         self.result.journal_events.extend(self.node.obs.journal.events())
+        self.result.journal_dropped += self.node.obs.journal.dropped
         self.result.restarts += 1
         self.node.close()   # encode pool + sqlite handle
         armed = self.plane.armed
@@ -491,6 +506,7 @@ class SimHarness:
             result.quiescent = False
         result.rounds = rounds
         result.journal_events.extend(self.node.obs.journal.events())
+        result.journal_dropped += self.node.obs.journal.dropped
         if self.node._pipeline is not None:
             # stop the encode pool; the db handle stays open — the
             # invariant checkers still audit it through the result
@@ -506,7 +522,8 @@ def run_scenario(scenario: Scenario, seed: int, *,
                  mesh: dict | None = None,
                  witness: bool = False,
                  precision: str = "bf16",
-                 perfscope: bool = False) -> SimResult:
+                 perfscope: bool = False,
+                 healthwatch: bool = False) -> SimResult:
     """Build a world, drive the scenario to quiescence, return the
     auditable result. `node_cls` lets regression tests inject a
     deliberately buggy node (tests/test_sim.py double-commit);
@@ -521,8 +538,13 @@ def run_scenario(scenario: Scenario, seed: int, *,
     (docs/quantization.md) — every SIM invariant must hold unchanged.
     `perfscope=True` installs the perf-card capture (docs/perfscope.md);
     cards are metering only, so CIDs must match a perfscope-off run
-    byte for byte (test-pinned)."""
+    byte for byte (test-pinned). `healthwatch=True` enables the live
+    alert engine (docs/healthwatch.md) on every node the harness
+    spawns — SIM113 then audits the fault→alert coverage (every
+    injected fault class raised its mapped alert, clean runs raised
+    none) and CIDs stay byte-identical on vs off."""
     return SimHarness(scenario, seed, db_path=db_path,
                       node_cls=node_cls, pipeline=pipeline,
                       mesh=mesh, witness=witness,
-                      precision=precision, perfscope=perfscope).run()
+                      precision=precision, perfscope=perfscope,
+                      healthwatch=healthwatch).run()

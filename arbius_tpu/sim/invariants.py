@@ -51,6 +51,19 @@ reproduces it byte-identically.
                                and no reveal without granted commit
                                rights — sim/bugs.py's double-lease
                                node must trip exactly this
+  SIM113  fault→alert coverage healthwatch runs only
+                               (docs/healthwatch.md): the live alert
+                               engine's journaled `alert_transition`
+                               record must COVER the run's faults —
+                               every injected fault class raised its
+                               mapped alert (a fault the monitoring
+                               never surfaced is a silent fault), AND
+                               every raised alert is explained by some
+                               injected fault or node-visible evidence
+                               (a clean run raises none; fail closed
+                               in BOTH directions) — sim/bugs.py's
+                               silent-fault node (drops the alert
+                               journal) must trip exactly this
   SIM112  trace completeness   fleet runs only (docs/fleetscope.md):
                                every task's cross-process span chain is
                                gap-free and hop-consistent — the lease
@@ -608,6 +621,133 @@ def check_trace_chain(result, find) -> None:
                      "task's trace chain")
 
 
+# -- SIM113: fault→alert coverage (docs/healthwatch.md) ---------------------
+#
+# The coverage map: which healthwatch alert class each injected fault
+# kind must raise. A fault kind maps to a TUPLE of acceptable alerts —
+# the invariant is "at least one of the class was raised" (reaching
+# pending counts: the class left ok, which is what an operator's pager
+# keys on). Timing-only faults (latency, runner_slow, pin_stall) have
+# no required alert: they are observable only as latency, and mapping
+# them would make the invariant lie. docs/healthwatch.md renders this
+# table; keep the two in sync.
+FAULT_ALERTS: dict[str, tuple[str, ...]] = {
+    "tx_error": ("rpc_degraded", "job_quarantine"),
+    "tx_lost_response": ("rpc_degraded", "job_quarantine"),
+    "view_error": ("rpc_degraded", "job_quarantine"),
+    "poll_error": ("rpc_degraded",),
+    "pin_fail": ("pin_degraded", "job_quarantine"),
+    "pin_mismatch": ("pin_degraded", "job_quarantine"),
+    "runner_crash": ("job_quarantine",),
+    "event_delay": ("chain_replay",),
+    "event_replay": ("chain_replay",),
+    "reorg": ("chain_replay",),
+    # a view error can raise out of an event SUBSCRIBER mid-dispatch,
+    # making the node re-poll (and honestly re-observe) the range —
+    # so chain faults may legitimately surface as observed replays
+    "crash": ("crash_recovered",),
+    # latency / runner_slow / pin_stall / coordinator_crash: timing or
+    # out-of-scope — no required alert (documented, not forgotten)
+}
+
+
+def _raised_alerts(result) -> set[str]:
+    return {ev.get("alert") for ev in result.journal_events
+            if ev.get("kind") == "alert_transition"}
+
+
+def check_alert_coverage(result, find) -> None:
+    """SIM113 (healthwatch-enabled runs only): the journaled
+    alert_transition record covers the run's faults in BOTH directions.
+
+      (a) required: every injected fault kind with a row in
+          FAULT_ALERTS saw at least one alert of its class raised
+          (leave ok at least once) somewhere in the run — across
+          crash-restarts and every fleet worker (journals are
+          unioned). Downgraded when any journal ring evicted events
+          (the SIM112 honesty bound: a missing transition behind a
+          nonzero dropped count may simply have fallen off the ring).
+          Evidence-derived requirements ride along: a task the node
+          drove to contested_resolved must have raised `contention`, a
+          task marked invalid must have raised `invalid_inputs`, and a
+          fleet run with lease steals must have raised `steal_surge`.
+      (b) allowed: every raised alert is explained by an injected
+          fault or by node-visible evidence — a clean run raises
+          NOTHING, so a trigger-happy rule (or a stale coverage map)
+          fails closed instead of normalizing alert noise."""
+    if not getattr(result, "healthwatch_enabled", False):
+        return
+    raised = _raised_alerts(result)
+    labels = classify_tasks(result)
+    faults = result.plane.fault_counts
+    # STEALS only — a coordinator RECLAIM's lag is observed under the
+    # coordinator's obs, and the coordinator runs no healthwatch, so
+    # no engine can ever raise steal_surge for it (a reclaimed lease
+    # reaches workers as an ordinary re-deal); requiring it would fail
+    # healthy reclaim-heavy runs
+    steals = any(h[0] == "steal"
+                 for h in getattr(result, "lease_history", ()))
+
+    # (a) required coverage
+    if getattr(result, "journal_dropped", 0) == 0:
+        required: dict[str, tuple[str, ...]] = {}
+        for kind, n in sorted(faults.items()):
+            if n > 0 and kind in FAULT_ALERTS:
+                required[f"fault {kind!r} (injected {n}x)"] = \
+                    FAULT_ALERTS[kind]
+        if any(lbl == "contested_resolved" for lbl in labels.values()):
+            required["a contestation this node drove to resolution"] = \
+                ("contention",)
+        if any(lbl == "invalid" for lbl in labels.values()):
+            required["a task marked invalid"] = ("invalid_inputs",)
+        if steals:
+            required["lease steals in the fleet history"] = \
+                ("steal_surge",)
+        for what, alerts in required.items():
+            if not (set(alerts) & raised):
+                find("SIM113", None,
+                     f"{what} raised NO alert of its mapped class "
+                     f"{list(alerts)} — the fault was silent: live "
+                     "monitoring never surfaced what the fault plane "
+                     "injected (docs/healthwatch.md coverage map)")
+
+    # (b) no unexplained alerts
+    allowed: set[str] = set()
+    for kind, n in faults.items():
+        if n > 0:
+            allowed.update(FAULT_ALERTS.get(kind, ()))
+    if faults.get("view_error", 0) > 0:
+        # a view error raising out of an event subscriber makes the
+        # node re-poll the range — an honestly OBSERVED replay
+        allowed.add("chain_replay")
+    if sum(faults.values()) > 0:
+        # any fault — including the timing-only kinds — may back up
+        # the staged executor; a stall alert under faults is signal,
+        # under a clean run it is noise
+        allowed.add("pipeline_stall")
+    if any(f.evil for f in result.tasks.values()) \
+            or result.engine.contestations:
+        allowed.add("contention")
+    if any(lbl == "invalid" for lbl in labels.values()):
+        allowed.add("invalid_inputs")
+    if any(db.failed_jobs() for db in _node_dbs(result)):
+        allowed.add("job_quarantine")
+    if result.restarts > 0:
+        allowed.add("crash_recovered")
+    if steals:
+        allowed.add("steal_surge")
+    for ev in result.journal_events:
+        if ev.get("kind") in ("retry", "retry_exhausted"):
+            allowed.add("pin_degraded" if str(ev.get("op", ""))
+                        .startswith("pin_") else "rpc_degraded")
+    for alert in sorted(raised - allowed):
+        find("SIM113", None,
+             f"alert {alert!r} was raised with no injected fault or "
+             "node-visible evidence mapping to it — either the rule is "
+             "trigger-happy or the FAULT_ALERTS coverage map is stale "
+             "(docs/healthwatch.md)")
+
+
 CHECKERS = (
     check_task_conservation,
     check_commit_before_reveal,
@@ -621,6 +761,7 @@ CHECKERS = (
     check_witness,
     check_fleet,
     check_trace_chain,
+    check_alert_coverage,
 )
 
 

@@ -1,0 +1,25 @@
+"""OBS501 alert-direction fixture: catalog rule ids vs the doc.
+
+Three `AlertRule(...)` constructors, each its own statement (a waiver
+pragma attaches to its enclosing statement): one naming a rule the
+REPO doc documents (clean — the forward direction checks the repo's
+docs/observability.md, like the metric fixtures), one ghost with no
+doc row (the finding the golden pins), and one waived. The sibling
+docs/observability.md in THIS tree exercises the rot direction: it
+documents one alert alive below and one whose name appears nowhere in
+this tree.
+"""
+from arbius_tpu.obs.healthwatch import AlertRule
+
+
+def catalog():
+    # documented in the repo doc's alert table: clean
+    documented = AlertRule(name="stuck_tick", summary="fixture",
+                           signal="stuck")
+    # no alert row anywhere: OBS501
+    ghost = AlertRule(name="fixture_ghost_rule", summary="fixture",
+                      signal="ghost")
+    # detlint: allow[OBS501] fixture: a deliberate throwaway rule
+    waived = AlertRule(name="fixture_waived_rule", summary="fixture",
+                       signal="waived")
+    return [documented, ghost, waived]

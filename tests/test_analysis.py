@@ -536,6 +536,54 @@ def test_obs501_doc_rot_respects_select():
                              root=str(fixroot), select={"DET101"})
 
 
+def test_obs501_alert_rule_names_are_checked():
+    """The alert direction (docs/healthwatch.md): a literal
+    AlertRule(name=…) under arbius_tpu/ with no `alert="…"` row in
+    docs/observability.md is OBS501, exactly like an undocumented
+    metric; documented catalog names are clean."""
+    src = ('ghost = AlertRule(name="zz_rotting_rule", summary="s",\n'
+           '                  signal="g")\n'
+           'ok = AlertRule(name="stuck_tick", summary="s",\n'
+           '               signal="stuck")\n')
+    hits = analyze_source(src, _OBS_PY)
+    assert rules_of(hits) == ["OBS501"]
+    assert "zz_rotting_rule" in hits[0].message
+    assert "alert" in hits[0].message
+    # outside the shipped tree, fixtures/tests build rules freely
+    assert not analyze_source(src, "tests/somefile.py")
+
+
+def test_obs501_every_catalog_rule_is_documented():
+    """Live on the real tree: every shipped healthwatch rule id
+    resolves against the doc's alert table (the whole-tree self-check
+    keeps this for every future rule)."""
+    from arbius_tpu.analysis.rules_obs import documented_alert_names
+    from arbius_tpu.obs.healthwatch import RULE_NAMES
+
+    documented = documented_alert_names()
+    for name in RULE_NAMES:
+        assert name in documented, name
+
+
+def test_obs501_alerts_fixture_golden_json():
+    """Both alert directions pinned byte-for-byte: the forward ghost
+    (a catalog rule with no doc row; the waived twin absorbed by
+    allow[]) and the rot direction (a documented alert whose rule
+    vanished from the fixture tree — anchored on the DOC line)."""
+    fixroot = FIXDIR / "obs501_alerts"
+    got = _json_report([str(fixroot / "arbius_tpu")], str(fixroot))
+    want = (FIXDIR / "obs501_alerts.golden.json").read_text()
+    assert got == want
+    doc = json.loads(got)
+    assert [f["rule"] for f in doc["findings"]] == ["OBS501"] * 2
+    paths = [f["path"] for f in doc["findings"]]
+    assert paths == ["arbius_tpu/alerts.py", "docs/observability.md"]
+    assert "fixture_ghost_rule" in doc["findings"][0]["message"]
+    assert "fixture_rotten_rule" in doc["findings"][1]["message"]
+    assert not any("fixture_waived_rule" in f["message"]
+                   for f in doc["findings"])
+
+
 # -- suppressions, enforce, LINT001 -----------------------------------------
 
 def test_inline_suppression_same_line_and_above():

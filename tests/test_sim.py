@@ -30,18 +30,22 @@ def matrix(tmp_path_factory):
     acceptance matrix — run once, audited by every test below. The
     seeded runs drive the staged solve pipeline (the harness default)
     WITH the conclint runtime witness instrumented (docs/concurrency.md:
-    SIM110 audits the observed lock-order graph on every matrix run);
-    one extra `(name, "sync")` run per scenario drives the SHIPPED
-    default (pipeline.enabled=false, witness off) through the same
-    fault plane so the synchronous _solve_bucket path never rots
-    uncovered — and doubles as the witness-off CID baseline."""
+    SIM110 audits the observed lock-order graph on every matrix run)
+    AND the healthwatch alert engine enabled (docs/healthwatch.md:
+    SIM113 audits the fault→alert coverage on every matrix run — each
+    fault scenario must raise its mapped alert class, clean must raise
+    none); one extra `(name, "sync")` run per scenario drives the
+    SHIPPED default (pipeline.enabled=false, witness off, healthwatch
+    off) through the same fault plane so the synchronous _solve_bucket
+    path never rots uncovered — and doubles as the witness-off AND
+    healthwatch-off CID baseline."""
     base = tmp_path_factory.mktemp("simnet")
     out = {}
     for name in TIER1_MATRIX:
         for seed in SEEDS:
             h = SimHarness(get_scenario(name), seed,
                            db_path=str(base / f"{name}-{seed}.sqlite"),
-                           witness=True)
+                           witness=True, healthwatch=True)
             result = h.run()
             out[(name, seed)] = (h, result, check_all(result))
         h = SimHarness(get_scenario(name), SEEDS[0],
@@ -89,13 +93,15 @@ def test_pipeline_and_sync_reach_identical_cids(matrix):
     """Same scenario, same seed, both schedules: every task's accepted
     solution CID is identical — the pipeline changed the schedule, not
     the bytes (the simnet version of the golden byte-equality gate).
-    The piped run is witness-INSTRUMENTED and the sync run is not, so
-    this same assertion pins that the conc witness is bookkeeping-only:
-    witness-on CIDs are byte-identical to witness-off."""
+    The piped run is witness-INSTRUMENTED and healthwatch-ENABLED while
+    the sync run is neither, so this same assertion pins that BOTH are
+    bookkeeping-only: witness-on/healthwatch-on CIDs are byte-identical
+    to the off baseline."""
     _, piped, _ = matrix[("clean", SEEDS[0])]
     _, sync, _ = matrix[("clean", "sync")]
     assert piped.witness_report is not None
     assert sync.witness_report is None
+    assert piped.healthwatch_enabled and not sync.healthwatch_enabled
     cids = lambda r: {"0x" + t.hex(): "0x" + s.cid.hex()
                       for t, s in r.engine.solutions.items()}
     assert cids(piped) == cids(sync) and cids(piped)
@@ -233,6 +239,102 @@ def test_reports_are_byte_identical_per_seed(matrix, tmp_path):
     assert a != json.dumps(summarize(other_seed), sort_keys=True)
 
 
+# -- healthwatch: fault→alert coverage (SIM113, docs/healthwatch.md) -------
+
+def _raised(result):
+    return sorted({e["alert"] for e in result.journal_events
+                   if e.get("kind") == "alert_transition"})
+
+
+def test_healthwatch_matrix_coverage_is_nondegenerate(matrix):
+    """Every matrix run already asserts zero findings — SIM113
+    included. Here: pin that the substrate is non-degenerate in BOTH
+    directions: clean raises NO alert, and each fault scenario's
+    journal shows its mapped alert class actually transitioning."""
+    assert _raised(matrix[("clean", SEEDS[0])][1]) == []
+    expect = {
+        "rpc-flap": "rpc_degraded",
+        "pin-fail": "pin_degraded",
+        "reorg": "chain_replay",
+        "crash-restart": "crash_recovered",
+        "contested": "contention",
+        "chaos": "job_quarantine",
+    }
+    for name, alert in expect.items():
+        for seed in SEEDS:
+            _, result, _ = matrix[(name, seed)]
+            assert result.healthwatch_enabled
+            assert alert in _raised(result), (name, seed,
+                                              _raised(result))
+
+
+def test_healthwatch_transitions_walk_the_state_machine(matrix):
+    """The journaled record is a legal state-machine walk: per alert,
+    consecutive transitions chain (prev == the last state), and each
+    event records a genuine change (the once-per-state-change
+    contract, generalized from perf_drift)."""
+    _, result, _ = matrix[("pin-fail", SEEDS[0])]
+    walks: dict[str, list] = {}
+    for ev in result.journal_events:
+        if ev.get("kind") != "alert_transition":
+            continue
+        walks.setdefault(ev["alert"], []).append(ev)
+    assert walks, "pin-fail journaled no transitions"
+    for alert, evs in walks.items():
+        state = "ok"
+        for ev in evs:
+            assert ev["prev"] == state, (alert, evs)
+            assert ev["state"] != ev["prev"], (alert, ev)
+            state = ev["state"]
+
+
+def test_injected_silent_fault_fails_sim113_only(tmp_path):
+    """sim/bugs.py silent-fault: a node whose monitoring went dark
+    (alert_transition events swallowed) under an actively faulting
+    scenario MUST be caught by SIM113's coverage audit — and by
+    nothing else (work still flows, retries still journal, CIDs still
+    land)."""
+    from arbius_tpu.sim.bugs import SilentFaultMinerNode
+
+    result = run_scenario(get_scenario("rpc-flap"), 0,
+                          db_path=str(tmp_path / "silent.sqlite"),
+                          node_cls=SilentFaultMinerNode,
+                          healthwatch=True)
+    findings = check_all(result)
+    sim113 = [f for f in findings if f.rule == "SIM113"]
+    assert sim113, "the monitoring blackout went uncaught"
+    assert "silent" in sim113[0].message
+    assert not [f for f in findings if f.rule != "SIM113"], \
+        "the injected blackout bled into other invariants"
+    # monitoring-only: the run itself is healthy
+    assert _raised(result) == []
+    assert any(e.get("kind") == "retry"
+               for e in result.journal_events), \
+        "faults stopped journaling — the scenario degenerated"
+
+
+def test_healthwatch_off_runs_skip_sim113(tmp_path):
+    """The shipped default (alerts.enabled=false) is not audited —
+    SIM113 gates on healthwatch_enabled exactly as SIM109/110 gate on
+    their instrumentation."""
+    from arbius_tpu.sim.bugs import SilentFaultMinerNode
+
+    result = run_scenario(get_scenario("rpc-flap").with_tasks(3), 0,
+                          db_path=str(tmp_path / "off.sqlite"),
+                          node_cls=SilentFaultMinerNode)
+    assert not result.healthwatch_enabled
+    assert not [f for f in check_all(result) if f.rule == "SIM113"]
+
+
+def test_cli_injected_silent_fault_exits_1(tmp_path, capsys):
+    # silent-fault implies --healthwatch and forces a fault scenario
+    rc = sim_main(["--inject-bug", "silent-fault", "--tasks", "4",
+                   "--workdir", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "SIM113" in captured.out
+
+
 # -- obs integration -------------------------------------------------------
 
 def test_fault_plane_counts_into_ambient_obs(matrix):
@@ -300,7 +402,9 @@ def test_cli_injected_bug_exits_1_with_repro_line(tmp_path, capsys):
 def fleet_matrix(tmp_path_factory):
     """(scenario, seed) → result for the fleet half of the acceptance
     matrix: real multi-node fleets (coordinator + N signed-tx workers
-    over the shared lease table) under the fleet failure schedules."""
+    over the shared lease table) under the fleet failure schedules,
+    every worker running its own healthwatch alert engine (SIM113
+    audits per-member fault→alert coverage, docs/healthwatch.md)."""
     from arbius_tpu.sim.fleet import run_fleet_scenario
     from arbius_tpu.sim.scenario import FLEET_TIER1
 
@@ -311,7 +415,8 @@ def fleet_matrix(tmp_path_factory):
             workdir = base / f"{name}-{seed}"
             workdir.mkdir()
             result = run_fleet_scenario(get_scenario(name), seed,
-                                        workdir=str(workdir))
+                                        workdir=str(workdir),
+                                        healthwatch=True)
             out[(name, seed)] = (result, check_all(result))
     return out
 
@@ -355,7 +460,12 @@ def test_fleet_partition_steals_expired_leases(fleet_matrix):
     ttl = result.scenario.fleet.lease_ttl
     assert all(h[4]["lag"] <= max(ttl, 2 * result.scenario.tick_seconds)
                for h in steals)
-    # stolen tasks still ended claimed (counted in the matrix test)
+    # stolen tasks still ended claimed (counted in the matrix test);
+    # the stealing worker's healthwatch raised steal_surge — the
+    # fleet half of SIM113's coverage (docs/healthwatch.md)
+    assert "steal_surge" in {
+        e.get("alert") for e in result.journal_events
+        if e.get("kind") == "alert_transition"}
 
 
 def test_fleet_coordinator_crash_recovers_leases(fleet_matrix):

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""benchkeeper — merge the per-PR BENCH_r*.json files into one
+canonical, stage-keyed BENCH_TRAJECTORY.json.
+
+Every PR's bench run writes a BENCH_r<NN>.json at the repo root, in
+one of three historical shapes (driver-era `{"n", "rc", "parsed"}`,
+single-stage `{"ok", "stage", "result"}`, multi-stage
+`{"round", "stages": {...}}`). Nothing aggregated them, so the bench
+trajectory — the thing the per-PR files exist to build — stayed
+empty. benchkeeper normalizes all three shapes into one schema and
+emits a stage-keyed series:
+
+    {
+      "version": 1,
+      "rounds": [4, 7, ...],          # rounds contributing any entry
+      "skipped": [{"round": 1, "reason": "..."}],
+      "stages": {
+        "sched_ab": [{"round": 7, "metric": ..., "value": ...,
+                      "unit": ..., "platform": ..., "vs_baseline": ...,
+                      "elapsed_s": ...}, ...]   # sorted by round
+      }
+    }
+
+    python tools/benchkeeper.py                   # write BENCH_TRAJECTORY.json
+    python tools/benchkeeper.py --dir . --json    # print, write nothing
+    python tools/benchkeeper.py --check           # drift audit (CI)
+
+**Schema validation**: every headline entry must carry a string
+`metric`, a numeric `value`, and a string `unit` — a malformed file
+raises BENCH801 naming the file and field. **--check** re-derives the
+trajectory and raises BENCH802 when the committed file differs —
+"someone landed a BENCH round without regenerating the trajectory" is
+a finding, not silence. Output is byte-deterministic for a fixed file
+set (files sort by round, stages by name, keys sorted) — pinned
+against the goldens in tests/fixtures/benchkeeper/.
+
+Exit codes follow the shared lint contract (0 clean / 1 findings /
+2 usage); `--json` prints the trajectory document itself (the
+findings document still goes to stderr rendering in --check mode).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+from _common import EXIT_CLEAN, EXIT_USAGE, lint_main
+
+TRAJECTORY = "BENCH_TRAJECTORY.json"
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json\Z")
+
+
+def _finding(path: str, rule: str, message: str, line: int = 0):
+    from arbius_tpu.analysis.core import Finding
+
+    return Finding(path=path, line=line, col=0, rule=rule,
+                   severity="error", message=message,
+                   snippet=os.path.basename(path))
+
+
+def _entry(rnd: int, result: dict, platform, fname: str,
+           findings: list) -> tuple[str, dict] | None:
+    """(stage, schema-checked series entry) from one headline result
+    dict, or None (with a BENCH801 finding) when the schema is off."""
+    stage = result.get("stage")
+    if not isinstance(stage, str) or not stage:
+        findings.append(_finding(
+            fname, "BENCH801",
+            "headline result has no string `stage` — benchkeeper "
+            "cannot key the series (docs/benchmarks.md)"))
+        return None
+    for field, types in (("metric", str), ("unit", str),
+                         ("value", (int, float))):
+        if not isinstance(result.get(field), types):
+            findings.append(_finding(
+                fname, "BENCH801",
+                f"stage {stage!r}: headline `{field}` is "
+                f"{type(result.get(field)).__name__}, expected "
+                f"{types if isinstance(types, type) else 'number'}"))
+            return None
+    entry = {
+        "round": rnd,
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "platform": platform,
+        "vs_baseline": result.get("vs_baseline"),
+        "elapsed_s": result.get("elapsed_s"),
+    }
+    return stage, entry
+
+
+def merge_bench_files(dirpath: str) -> tuple[dict, list]:
+    """(trajectory document, BENCH801 findings) from every
+    BENCH_r*.json under `dirpath`. Deterministic: files sort by round
+    number, never by filesystem order."""
+    files = []
+    for fname in os.listdir(dirpath):
+        m = _BENCH_RE.match(fname)
+        if m:
+            files.append((int(m.group(1)), fname))
+    files.sort()
+    findings: list = []
+    stages: dict[str, list] = {}
+    skipped: list[dict] = []
+    rounds: set[int] = set()
+    for rnd, fname in files:
+        path = os.path.join(dirpath, fname)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            findings.append(_finding(fname, "BENCH801",
+                                     f"unreadable bench file: {e}"))
+            continue
+        if not isinstance(doc, dict):
+            findings.append(_finding(fname, "BENCH801",
+                                     "bench file is not a JSON object"))
+            continue
+        if "round" in doc and doc["round"] != rnd:
+            findings.append(_finding(
+                fname, "BENCH801",
+                f"file says round {doc['round']} but the filename says "
+                f"{rnd} — a misnamed (or miscopied) bench round"))
+            continue
+        pairs: list[tuple[str, dict]] = []
+        if "stages" in doc:                      # multi-stage (r14+)
+            for name in sorted(doc["stages"]):
+                block = doc["stages"][name] or {}
+                res = block.get("result") or {}   # tolerate null
+                pair = _entry(rnd, dict(res, stage=res.get("stage",
+                                                           name)),
+                              block.get("platform"), fname, findings)
+                if pair is not None:
+                    pairs.append(pair)
+        elif "result" in doc:                    # single-stage
+            pair = _entry(rnd, doc.get("result") or {},
+                          doc.get("platform"), fname, findings)
+            if pair is not None:
+                pairs.append(pair)
+        elif "parsed" in doc:                    # driver-era
+            if doc.get("parsed"):
+                pair = _entry(rnd, doc["parsed"], None, fname,
+                              findings)
+                if pair is not None:
+                    pairs.append(pair)
+            else:
+                skipped.append({
+                    "round": rnd,
+                    "reason": "no parsed result "
+                              f"(driver rc={doc.get('rc')})"})
+        else:
+            findings.append(_finding(
+                fname, "BENCH801",
+                "unrecognized bench shape: none of stages/result/"
+                "parsed present"))
+        for stage, entry in pairs:
+            stages.setdefault(stage, []).append(entry)
+            rounds.add(rnd)
+    for series in stages.values():
+        series.sort(key=lambda e: e["round"])
+    doc = {
+        "version": 1,
+        "rounds": sorted(rounds),
+        "skipped": sorted(skipped, key=lambda s: s["round"]),
+        "stages": {k: stages[k] for k in sorted(stages)},
+    }
+    return doc, findings
+
+
+def render_trajectory(doc: dict) -> str:
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
+def build_arg_parser(p):
+    p.add_argument("--dir", default=".",
+                   help="directory holding BENCH_r*.json (default: .)")
+    p.add_argument("--out", default=None,
+                   help=f"output path (default: <dir>/{TRAJECTORY})")
+    p.add_argument("--check", action="store_true",
+                   help="verify the committed trajectory matches a "
+                        "regeneration (BENCH802 on drift); writes "
+                        "nothing")
+    p.add_argument("--json", action="store_true",
+                   help="print the trajectory document to stdout "
+                        "instead of writing it")
+    return p
+
+
+def collect(ns):
+    if not os.path.isdir(ns.dir):
+        print(f"benchkeeper: {ns.dir!r} is not a directory",
+              file=sys.stderr)
+        return EXIT_USAGE, []
+    doc, findings = merge_bench_files(ns.dir)
+    text = render_trajectory(doc)
+    out_path = ns.out or os.path.join(ns.dir, TRAJECTORY)
+    if ns.check:
+        try:
+            with open(out_path, encoding="utf-8") as fh:
+                committed = fh.read()
+        except OSError:
+            committed = None
+        if committed != text:
+            findings.append(_finding(
+                os.path.basename(out_path), "BENCH802",
+                "committed trajectory does not match a regeneration "
+                "from the BENCH_r*.json set — re-run "
+                "`python tools/benchkeeper.py` and commit the result"))
+        return None, findings
+    # write/print modes: the trajectory document owns stdout, so
+    # schema findings render to stderr and only set the exit code
+    from _common import EXIT_FINDINGS
+
+    for f in findings:
+        print(f.text(), file=sys.stderr)
+    if ns.json:
+        sys.stdout.write(text)
+        return (EXIT_FINDINGS if findings else EXIT_CLEAN), []
+    with open(out_path, "w", encoding="utf-8", newline="\n") as fh:
+        fh.write(text)
+    n = sum(len(s) for s in doc["stages"].values())
+    print(f"benchkeeper: wrote {out_path} ({n} entries across "
+          f"{len(doc['stages'])} stage(s), {len(doc['skipped'])} "
+          "round(s) skipped)", file=sys.stderr)
+    return (EXIT_FINDINGS if findings else EXIT_CLEAN), []
+
+
+def render(ns, findings, out):
+    from arbius_tpu.analysis.cli import render_json
+
+    if ns.json:
+        render_json(findings, out)
+        return
+    for f in findings:
+        out.write(f.text() + "\n")
+    if findings:
+        out.write(f"benchkeeper: {len(findings)} finding(s)\n")
+
+
+def main(argv=None) -> int:
+    return lint_main("benchkeeper", __doc__, build_arg_parser, collect,
+                     render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""healthwatch — alert catalog listing + offline fleet alert audit.
+
+Two offline views over the live alert engine (docs/healthwatch.md):
+
+    python tools/healthwatch.py --rules                  # the catalog
+    python tools/healthwatch.py --eval <sidecar-dir>     # fleet audit
+    python tools/healthwatch.py --eval <sidecar-dir> --json
+
+**--rules** prints the shipped alert catalog (rule id, hysteresis,
+signal, summary) at the default `alerts` configuration — the same
+catalog OBS501's alert direction holds to docs/observability.md rows.
+
+**--eval** reads a fleetscope sidecar directory (`fleet.sidecar_dir`,
+docs/fleetscope.md): every member's persisted registry export carries
+its healthwatch gauges (`arbius_alert_state{alert}` +
+`arbius_alert_transitions_total{alert}`), so the fleet's alert posture
+is auditable after the fact, per member, with no process to talk to.
+A member whose snapshot shows a FIRING alert raises:
+
+    HW701  alert firing on a fleet member at its last sidecar flush —
+           the node ended (or last flushed) in a known-bad state
+
+Pending/resolved states render in the table but do not fail the audit
+(they are hysteresis in motion, not a standing condition). Members
+without healthwatch gauges are listed as unwatched — a fleet that
+*meant* to run the alert engine sees the gap instead of silence.
+
+Exit codes follow the shared lint contract (0 clean / 1 findings /
+2 usage); `--json` emits the same stable findings document every
+linter tool does. Output is byte-deterministic for a fixed sidecar
+set (members sort by name, alerts by rule id) — tier-1-pinned against
+the goldens in tests/fixtures/healthwatch/.
+"""
+from __future__ import annotations
+
+import sys
+
+from _common import EXIT_CLEAN, EXIT_USAGE, lint_main
+
+STATE_NAMES = {0: "ok", 1: "pending", 2: "firing", 3: "resolved"}
+
+
+def catalog_lines() -> list[str]:
+    """The shipped rule catalog at default config, one line per rule."""
+    from arbius_tpu.node.config import AlertsConfig
+    from arbius_tpu.obs.healthwatch import default_catalog
+
+    lines = []
+    for rule in default_catalog(AlertsConfig()):
+        lines.append(f"{rule.name:22s} for_ticks={rule.for_ticks:<3d} "
+                     f"signal={rule.signal:14s} {rule.summary}")
+    return lines
+
+
+def eval_sidecars(dirpath: str) -> tuple[list[dict], list]:
+    """(per-member alert state rows, HW701 findings) from a fleetscope
+    sidecar directory. Rows sort by (member, alert); a member without
+    healthwatch gauges yields one `watched: False` row."""
+    from arbius_tpu.analysis.core import Finding
+    from arbius_tpu.obs.fleetscope import read_sidecars
+
+    rows: list[dict] = []
+    findings = []
+    for member, export, _events in read_sidecars(dirpath,
+                                                 with_events=False):
+        metrics = export.get("metrics", {})
+        states = metrics.get("arbius_alert_state")
+        if states is None:
+            rows.append({"member": member, "alert": None,
+                         "state": None, "watched": False,
+                         "transitions": 0})
+            continue
+        transitions = {
+            key[0]: value for key, value in
+            (metrics.get("arbius_alert_transitions_total") or {})
+            .get("series", ())}
+        for key, value in states.get("series", ()):
+            alert = key[0]
+            state = STATE_NAMES.get(int(value), f"state-{int(value)}")
+            rows.append({"member": member, "alert": alert,
+                         "state": state, "watched": True,
+                         "transitions": int(transitions.get(alert, 0))})
+            if state == "firing":
+                findings.append(Finding(
+                    path=member, line=0, col=0, rule="HW701",
+                    severity="error",
+                    message=(f"alert `{alert}` was FIRING at this "
+                             "member's last sidecar flush — the node "
+                             "ended (or last reported) in a known-bad "
+                             "state (docs/healthwatch.md)"),
+                    snippet=f"{member}:{alert}"))
+    rows.sort(key=lambda r: (r["member"], r["alert"] or ""))
+    findings.sort()
+    return rows, findings
+
+
+def build_arg_parser(p):
+    p.add_argument("--rules", action="store_true",
+                   help="print the shipped alert catalog and exit")
+    p.add_argument("--eval", metavar="DIR", default=None,
+                   help="audit every member sidecar under DIR "
+                        "(fleet.sidecar_dir) — HW701 per firing alert")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings (eval mode)")
+    return p
+
+
+def collect(ns):
+    ns._rows = []
+    if ns.rules:
+        for line in catalog_lines():
+            print(line)
+        return EXIT_CLEAN, []
+    if not ns.eval:
+        print("healthwatch: --rules or --eval <sidecar-dir> is required",
+              file=sys.stderr)
+        return EXIT_USAGE, []
+    try:
+        ns._rows, findings = eval_sidecars(ns.eval)
+    except (OSError, ValueError) as e:
+        print(f"healthwatch: {e}", file=sys.stderr)
+        return EXIT_USAGE, []
+    return None, findings
+
+
+def render(ns, findings, out):
+    from arbius_tpu.analysis.cli import render_json
+
+    if ns.json:
+        render_json(findings, out)
+        return
+    interesting = [r for r in ns._rows
+                   if not r["watched"] or r["state"] != "ok"]
+    for r in interesting:
+        if not r["watched"]:
+            out.write(f"{r['member']:16s} UNWATCHED (no healthwatch "
+                      "gauges in this member's snapshot)\n")
+        else:
+            out.write(f"{r['member']:16s} {r['alert']:22s} "
+                      f"{r['state']:9s} transitions="
+                      f"{r['transitions']}\n")
+    for f in findings:
+        out.write(f.text() + "\n")
+    watched = sum(1 for r in ns._rows if r["watched"])
+    out.write(f"healthwatch: {len(findings)} firing alert(s) across "
+              f"{watched} watched state row(s)\n")
+
+
+def main(argv=None) -> int:
+    return lint_main("healthwatch", __doc__, build_arg_parser, collect,
+                     render, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,7 @@ RPC port (docs/observability.md) and renders it for a terminal:
     python tools/obs_dump.py metrics                  # JSON metrics view
     python tools/obs_dump.py prom                     # raw Prometheus text
     python tools/obs_dump.py journal [--limit 50] [--kind retry]
+    python tools/obs_dump.py journal --taskid 0x<taskid>
     python tools/obs_dump.py trace 0x<taskid>         # span tree
 
 Target selection: --url http://127.0.0.1:<rpc_port> (default port 8080,
@@ -105,6 +106,10 @@ def _fleet_main(ns) -> int:
     if ns.cmd == "journal":
         if ns.kind:
             events = [e for e in events if e.get("kind") == ns.kind]
+        if getattr(ns, "taskid", None):
+            events = [e for e in events
+                      if e.get("taskid") == ns.taskid
+                      or ns.taskid in (e.get("taskids") or ())]
         # explicit: limit<=0 means "no events", not "all of them"
         # (events[-0:] would slice the whole list)
         print(render_timeline(events[-ns.limit:] if ns.limit > 0
@@ -137,7 +142,11 @@ def main(argv=None) -> int:
     sp = sub.add_parser("journal", help="event journal (/debug/journal)")
     sp.add_argument("--limit", type=int, default=200)
     sp.add_argument("--kind", default=None,
-                    help="filter by event kind (span, retry, job_failed, …)")
+                    help="filter by event kind (span, retry, job_failed, "
+                         "alert_transition, …)")
+    sp.add_argument("--taskid", default=None,
+                    help="filter to one task's events (the /debug/trace "
+                         "matching: taskid field or taskids membership)")
     sp = sub.add_parser("trace", help="span tree for a task (/debug/trace)")
     sp.add_argument("taskid")
     ns = p.parse_args(argv)
@@ -150,7 +159,8 @@ def main(argv=None) -> int:
     elif ns.cmd == "prom":
         print(fetch_text(f"{base}/metrics"), end="")
     elif ns.cmd == "journal":
-        q = f"?limit={ns.limit}" + (f"&kind={ns.kind}" if ns.kind else "")
+        q = f"?limit={ns.limit}" + (f"&kind={ns.kind}" if ns.kind else "") \
+            + (f"&taskid={ns.taskid}" if ns.taskid else "")
         body = fetch_json(f"{base}/debug/journal{q}")
         print(render_journal(body["events"]))
         print(f"-- {len(body['events'])} event(s), capacity "
