@@ -1,0 +1,21 @@
+"""textgen — deterministic LLM text generation (docs/text-serving.md)."""
+from arbius_tpu.models.textgen.model import TextGenConfig, TextGenModel
+from arbius_tpu.models.textgen.pipeline import (
+    BOS_ID,
+    EOS_ID,
+    MESH_LAYOUTS,
+    SAMPLERS,
+    TextGenPipeline,
+    tokens_to_bytes,
+)
+
+__all__ = [
+    "BOS_ID",
+    "EOS_ID",
+    "MESH_LAYOUTS",
+    "SAMPLERS",
+    "TextGenConfig",
+    "TextGenModel",
+    "TextGenPipeline",
+    "tokens_to_bytes",
+]

@@ -1,0 +1,185 @@
+"""Decoder-only text transformer with an explicit KV-cache API.
+
+The textgen family's model is deliberately small and boring: token +
+learned position embeddings, pre-LayerNorm attention/MLP blocks, a
+final f32 LayerNorm and an f32 logits head. What makes it the repo's
+LLM-serving shape is the SPLIT API the pipeline jits around:
+
+  * `prefill(ids, total)`   — one dense causal pass over the padded
+    prompt bucket; returns the last position's logits plus per-layer
+    K/V caches already allocated at the bucket's full sequence length
+    (`total` = prompt bucket + decode bucket), prompt rows filled.
+  * `decode(tok, kv, pos)`  — one autoregressive step: embed a single
+    token at `pos`, write its K/V into the carried caches, attend over
+    positions <= pos, return next-token logits and the updated caches.
+
+Both methods read the SAME parameters (setup-style submodules), so the
+prefill and decode programs — two separately-goldened determinism
+classes (docs/text-serving.md) — can never drift apart structurally.
+Attention logits and softmax accumulate in f32 exactly like the image
+towers (models/common.py discipline); K/V caches store the compute
+dtype.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# additive mask value: large-negative f32, the zoo's masked-softmax
+# convention (finite so a fully-masked row still normalizes)
+_NEG = -1e30
+
+
+@dataclass(frozen=True)
+class TextGenConfig:
+    # 512 keeps the byte tokenizer's id space (0..255 bytes, bos 257,
+    # eos 258) with headroom, and matches the tiny text-tower vocab
+    vocab_size: int = 512
+    # must cover max(prompt_buckets) + max(decode_buckets) of any
+    # pipeline built on this topology (TextGenPipeline validates)
+    max_positions: int = 128
+    width: int = 64
+    layers: int = 2
+    heads: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def head_dim(self) -> int:
+        return self.width // self.heads
+
+    def __post_init__(self):
+        if self.width % self.heads:
+            raise ValueError(
+                f"width ({self.width}) must be divisible by heads "
+                f"({self.heads})")
+
+    @classmethod
+    def tiny(cls) -> "TextGenConfig":
+        return cls(vocab_size=512, max_positions=96, width=16,
+                   layers=1, heads=2)
+
+
+class _DecoderBlock(nn.Module):
+    cfg: TextGenConfig
+
+    def setup(self):
+        cfg = self.cfg
+        dt = cfg.jdtype
+        self.ln1 = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)
+        self.wq = nn.Dense(cfg.width, dtype=dt)
+        self.wk = nn.Dense(cfg.width, dtype=dt)
+        self.wv = nn.Dense(cfg.width, dtype=dt)
+        self.wo = nn.Dense(cfg.width, dtype=dt)
+        self.ln2 = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32)
+        self.mlp_up = nn.Dense(cfg.width * 4, dtype=dt)
+        self.mlp_down = nn.Dense(cfg.width, dtype=dt)
+
+    def _split(self, x):
+        return x.reshape(*x.shape[:-1], self.cfg.heads, self.cfg.head_dim)
+
+    def _mlp(self, x):
+        h = self.ln2(x).astype(self.cfg.jdtype)
+        h = self.mlp_down(nn.gelu(self.mlp_up(h), approximate=False))
+        return x + h
+
+    def prefill(self, x):
+        """x[B, P, W] → (x'[B, P, W], k[B, P, H, D], v[B, P, H, D])."""
+        cfg = self.cfg
+        dt = cfg.jdtype
+        h = self.ln1(x).astype(dt)
+        q = self._split(self.wq(h))
+        k = self._split(self.wk(h))
+        v = self._split(self.wv(h))
+        scale = cfg.head_dim ** -0.5
+        logits = jnp.einsum("bphd,bmhd->bhpm", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        p = x.shape[1]
+        causal = jnp.tril(jnp.ones((p, p), bool))
+        logits = jnp.where(causal[None, None], logits, _NEG)
+        att = jax.nn.softmax(logits, axis=-1).astype(dt)
+        o = jnp.einsum("bhpm,bmhd->bphd", att, v)
+        x = x + self.wo(o.reshape(*o.shape[:2], cfg.width))
+        return self._mlp(x), k, v
+
+    def decode(self, x, k_cache, v_cache, pos):
+        """One step: x[B, W] is the token at `pos`; caches [B, S, H, D]
+        get this position's K/V written in place (dynamic_update_slice,
+        so `pos` may be a traced scan index) and attention reads
+        positions <= pos only."""
+        cfg = self.cfg
+        dt = cfg.jdtype
+        h = self.ln1(x).astype(dt)
+        q = self._split(self.wq(h))          # [B, H, D]
+        k_new = self._split(self.wk(h))
+        v_new = self._split(self.wv(h))
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_new[:, None].astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_new[:, None].astype(v_cache.dtype), (0, pos, 0, 0))
+        scale = cfg.head_dim ** -0.5
+        logits = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) * scale
+        valid = jnp.arange(k_cache.shape[1]) <= pos
+        logits = jnp.where(valid[None, None], logits, _NEG)
+        att = jax.nn.softmax(logits, axis=-1).astype(dt)
+        o = jnp.einsum("bhs,bshd->bhd", att, v_cache.astype(dt))
+        x = x + self.wo(o.reshape(o.shape[0], cfg.width))
+        return self._mlp(x), k_cache, v_cache
+
+
+class TextGenModel(nn.Module):
+    """Decoder-only LM; `prefill` and `decode` share every parameter."""
+    config: TextGenConfig
+
+    def setup(self):
+        cfg = self.config
+        self.token_embed = nn.Embed(cfg.vocab_size, cfg.width,
+                                    dtype=cfg.jdtype, name="token_embed")
+        self.pos_embed = self.param("pos_embed",
+                                    nn.initializers.normal(0.01),
+                                    (cfg.max_positions, cfg.width))
+        self.blocks = [_DecoderBlock(cfg, name=f"layer_{i}")
+                       for i in range(cfg.layers)]
+        self.final_norm = nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32,
+                                       name="final_norm")
+        # f32 head: sampling (argmax / top-k) must compare logits at
+        # full precision — a bf16 head could tie-break differently
+        # across XLA versions
+        self.lm_head = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                                name="lm_head")
+
+    def prefill(self, ids, total: int):
+        """ids[B, P] → (logits[B, V] f32 at the last prompt position,
+        per-layer ((k, v), ...) caches of length `total` with rows
+        0..P-1 filled). `total` is static (the bucket's P + T)."""
+        cfg = self.config
+        dt = cfg.jdtype
+        p = ids.shape[1]
+        x = self.token_embed(ids) + self.pos_embed[None, :p].astype(dt)
+        kv = []
+        for blk in self.blocks:
+            x, k, v = blk.prefill(x)
+            pad = ((0, 0), (0, total - p), (0, 0), (0, 0))
+            kv.append((jnp.pad(k, pad), jnp.pad(v, pad)))
+        x = self.final_norm(x[:, -1])
+        return self.lm_head(x.astype(jnp.float32)), tuple(kv)
+
+    def decode(self, tok, kv, pos):
+        """tok[B] int32 at position `pos` → (logits[B, V] f32 for the
+        NEXT position, updated caches)."""
+        cfg = self.config
+        x = self.token_embed(tok) \
+            + jnp.take(self.pos_embed, pos, axis=0).astype(cfg.jdtype)
+        new_kv = []
+        for blk, (k, v) in zip(self.blocks, kv):
+            x, k, v = blk.decode(x, k, v, pos)
+            new_kv.append((k, v))
+        x = self.final_norm(x)
+        return self.lm_head(x.astype(jnp.float32)), tuple(new_kv)

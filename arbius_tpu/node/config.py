@@ -317,6 +317,57 @@ class AlertsConfig:
 
 
 @dataclass(frozen=True)
+class TextgenConfig:
+    """Sequence-bucket policy for the textgen family
+    (docs/text-serving.md): a task's prompt pads to the smallest
+    `prompt_buckets` edge that fits it and its requested budget rounds
+    up to the smallest `decode_buckets` edge — each (prompt, decode,
+    sampler, batch) combination is ONE jitted XLA program, so these
+    edges bound the compile count AND define the family's determinism
+    classes. Like canonical_batch and the mesh layout, bucket edges are
+    fleet-wide per model class: the prompt edge changes the positions
+    tokens sit at and therefore the output bytes."""
+    prompt_buckets: tuple = (32, 64)
+    decode_buckets: tuple = (16, 32)
+    # hydration-level cap on a task's requested token budget; must be
+    # servable by some decode bucket or the task could never solve
+    max_new_tokens: int = 32
+    # the k of seeded top-k sampling — part of the compiled program,
+    # fleet-wide like the bucket edges
+    top_k: int = 8
+
+    def __post_init__(self):
+        for name, edges in (("prompt_buckets", self.prompt_buckets),
+                            ("decode_buckets", self.decode_buckets)):
+            if not isinstance(edges, (tuple, list)) or not edges:
+                raise ConfigError(f"textgen.{name} must be a non-empty "
+                                  "ascending list of positive integers")
+            prev = 0
+            for e in edges:
+                if not isinstance(e, int) or isinstance(e, bool) \
+                        or e <= prev:
+                    raise ConfigError(
+                        f"textgen.{name} must be a non-empty ascending "
+                        "list of positive integers")
+                prev = e
+        if self.prompt_buckets[0] < 3:
+            raise ConfigError("textgen.prompt_buckets edges must be >= 3 "
+                              "(bos + at least one byte + eos)")
+        if not isinstance(self.max_new_tokens, int) \
+                or isinstance(self.max_new_tokens, bool) \
+                or self.max_new_tokens < 1:
+            raise ConfigError("textgen.max_new_tokens must be an integer "
+                              ">= 1")
+        if self.max_new_tokens > max(self.decode_buckets):
+            raise ConfigError("textgen.max_new_tokens must not exceed the "
+                              "largest decode bucket edge — a budget no "
+                              "bucket can serve would be unmineable")
+        if not isinstance(self.top_k, int) or isinstance(self.top_k, bool) \
+                or self.top_k < 1:
+            raise ConfigError("textgen.top_k must be an integer >= 1")
+
+
+@dataclass(frozen=True)
 class SLOConfig:
     """First-class service-level objectives over the fleet's chain-time
     latency corpus (docs/fleetscope.md): each threshold declares an
@@ -525,6 +576,10 @@ class MiningConfig:
     # live alert engine (docs/healthwatch.md); default OFF = no
     # evaluation, no alert gauges — the pre-healthwatch node
     alerts: AlertsConfig = AlertsConfig()
+    # sequence-bucket policy for the textgen family
+    # (docs/text-serving.md); fleet-wide determinism-class config like
+    # canonical_batch — inert unless a textgen-template model is enabled
+    textgen: TextgenConfig = TextgenConfig()
     # delegated-validator seam (blockchain.ts:44-67 keeps the same seam,
     # disabled): stake reads and deposits target this address instead of
     # the node's wallet — validatorDeposit(validator, amount) is already
@@ -626,10 +681,15 @@ def load_config(raw: str | dict) -> MiningConfig:
     perfscope = build(PerfscopeConfig, obj.pop("perfscope", {}),
                       "perfscope")
     alerts = build(AlertsConfig, obj.pop("alerts", {}), "alerts")
+    tg_raw = dict(obj.pop("textgen", {}))
+    for k in ("prompt_buckets", "decode_buckets"):
+        if isinstance(tg_raw.get(k), list):
+            tg_raw[k] = tuple(tg_raw[k])
+    textgen = build(TextgenConfig, tg_raw, "textgen")
     return build(MiningConfig,
                  dict(models=tuple(models), automine=automine, stake=stake,
                       ipfs=ipfs, pipeline=pipeline, sched=sched,
                       fleet=fleet, slo=slo, aot_cache=aot_cache,
                       precision=precision, perfscope=perfscope,
-                      alerts=alerts, **obj),
+                      alerts=alerts, textgen=textgen, **obj),
                  "config")

@@ -52,13 +52,19 @@ def bucket_str(key: tuple) -> str:
     """Canonical bucket-shape string for a node bucket key
     `(model, width, height, steps, scheduler, num_frames[, mode])` —
     the shape part only (model, layout, and precision mode ride
-    separately in the cost tag)."""
+    separately in the cost tag). Text-family 9-tuples
+    (docs/text-serving.md) append their sequence edges as
+    `.p<prompt>.t<decode>`; legacy keys render the historic string
+    byte for byte."""
     w, h, steps, sched, frames = key[1:6]
 
     def s(v):
         return "-" if v is None else str(v)
 
-    return f"{s(w)}x{s(h)}.s{s(steps)}.{s(sched)}.f{s(frames)}"
+    base = f"{s(w)}x{s(h)}.s{s(steps)}.{s(sched)}.f{s(frames)}"
+    if len(key) > 7:
+        base += f".p{s(key[7])}.t{s(key[8])}"
+    return base
 
 
 def make_cost_tag(model: str, bucket: str, layout: str, n: int,

@@ -238,6 +238,21 @@ def probe_golden_input(shape: str):
     return resolve_file, {"input_video": clip_cid}
 
 
+def _textgen(m: ModelConfig, mesh, mode: str, tg):
+    """textgen builder — takes the fleet-wide sequence-bucket policy
+    (cfg.textgen) on top of the common (model, mesh, mode) triple, so
+    it is special-cased in build_registry rather than in _BUILDERS."""
+    from arbius_tpu.models.textgen import TextGenConfig, TextGenPipeline
+    from arbius_tpu.node.solver import TextGenRunner
+
+    cfg = TextGenConfig.tiny() if m.tiny else TextGenConfig()
+    pipe = TextGenPipeline(cfg, mesh=mesh, precision=mode,
+                           prompt_buckets=tuple(tg.prompt_buckets),
+                           decode_buckets=tuple(tg.decode_buckets),
+                           top_k=tg.top_k)
+    return TextGenRunner(pipe, _params_for(pipe, m))
+
+
 def _rvm(m: ModelConfig, mesh, resolve_file):
     from arbius_tpu.models.rvm import RVMPipeline, RVMPipelineConfig
 
@@ -267,6 +282,7 @@ _MESH_CONTRACT_MODULES = {
     "kandinsky2": "arbius_tpu.models.kandinsky2.pipeline",
     "zeroscopev2xl": "arbius_tpu.models.video.pipeline",
     "damo": "arbius_tpu.models.video.pipeline",
+    "textgen": "arbius_tpu.models.textgen.pipeline",
 }
 
 
@@ -323,6 +339,10 @@ def build_registry(cfg: MiningConfig, *, mesh=None,
                             "skipping", m.id)
                 continue
             runner = _rvm(m, mesh, resolve_file)
+        elif m.template == "textgen":
+            # carries the fleet-wide sequence-bucket policy on top of
+            # the common builder triple (docs/text-serving.md)
+            runner = _textgen(m, mesh, mode, cfg.textgen)
         elif m.template in _BUILDERS:
             runner = _BUILDERS[m.template](m, mesh, mode)
         else:

@@ -646,6 +646,15 @@ class MinerNode:
                            error=f"{type(e).__name__}: {e}")
             return
         hydrated["seed"] = taskid2seed(taskid)
+        # runner intake hook: a family may stamp derived bucket fields
+        # onto the hydrated input (textgen's _prompt_bucket/
+        # _decode_bucket — docs/text-serving.md) so the precise gate,
+        # store_task_input, and the solve-batch bucket_key all see one
+        # consistent shape. Pure in (input, fleet config): every honest
+        # node derives the same fields.
+        prep = getattr(m.runner, "prepare_hydrated", None)
+        if prep is not None:
+            hydrated = prep(hydrated)
         # precise per-bucket gate, costsched only: the learned model
         # prices per bucket SHAPE, and the shape only exists once the
         # template's defaults are folded in — so this second pass can

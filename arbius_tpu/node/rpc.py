@@ -20,6 +20,13 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+# GET /debug/costmodel row bound (docs/text-serving.md): a sequence-
+# bucketed family's (prompt × decode × sampler) space is unbounded, and
+# the perfscope join below the cap is O(rows × cards) — the view caps
+# its payload and reports `rows_omitted` instead of growing forever
+# (tools/costmodel.py RENDER_CAP is the CLI-side twin)
+COSTMODEL_ROW_CAP = 64
+
 
 class ControlRPC:
     def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
@@ -612,6 +619,12 @@ class ControlRPC:
                     "min_fee_per_second": str(cfg.min_fee_per_second),
                     "static_seconds": self.node._static_solve_seconds(),
                 }
+            if len(cost_model["rows"]) > COSTMODEL_ROW_CAP:
+                # cap BEFORE the perfscope join — the join iterates
+                # exactly the rows that ship
+                cost_model["rows_omitted"] = (len(cost_model["rows"])
+                                              - COSTMODEL_ROW_CAP)
+                cost_model["rows"] = cost_model["rows"][:COSTMODEL_ROW_CAP]
             if scope is not None:
                 # perfscope join (docs/perfscope.md) OUTSIDE the state
                 # lock: the snapshot above already copied the rows into

@@ -35,6 +35,12 @@ from arbius_tpu.node.costmodel import bucket_str
 
 log = logging.getLogger("arbius.sched")
 
+# the sequence-bucket total (prompt edge + decode edge) at which a cold
+# text bucket's static prior equals the plain static estimate — the
+# scale anchor for the token-linear cold-start heuristic in
+# CostSched._predict (docs/scheduler.md, docs/text-serving.md)
+_SEQ_BASELINE_TOKENS = 64
+
 
 @dataclass
 class PackedBucket:
@@ -116,7 +122,18 @@ class CostSched(FifoSched):
             bucket_mode(key))
         if per_task is not None:
             return per_task * n_tasks, "cost_model"
-        return self.node._static_solve_seconds(), "static"
+        static = self.node._static_solve_seconds()
+        if len(key) > 7 and key[7] is not None and key[8] is not None:
+            # sequence-bucketed family, cold key (docs/text-serving.md):
+            # decode cost is near-linear in total tokens (prompt edge +
+            # decode edge), so scale the static prior by the bucket's
+            # token count relative to a mid-sized reference bucket —
+            # cold-start packing then prefers short sequences at equal
+            # fees instead of pricing a 96-token bucket like a 20-token
+            # one. Ordering-only: the estimate never touches bytes.
+            tokens = int(key[7]) + int(key[8])
+            return static * tokens / _SEQ_BASELINE_TOKENS, "static_seq"
+        return static, "static"
 
     def pack(self, buckets: list) -> list:
         """Order `[(key, entries, fee_sum)]` by descending predicted

@@ -72,10 +72,25 @@ def bucket_key(model_id: str, hydrated: dict, mode: str = "bf16") -> tuple:
     ONE batched dispatch; the key is also the cost model's bucket
     feature and the packer's unit of reordering (node/sched.py,
     docs/scheduler.md), so it lives here — next to the chunking it must
-    agree with — not in the node."""
-    return (model_id, hydrated.get("width"), hydrated.get("height"),
-            hydrated.get("num_inference_steps"),
-            hydrated.get("scheduler"), hydrated.get("num_frames"), mode)
+    agree with — not in the node.
+
+    Text templates (docs/text-serving.md) fill the scheduler slot with
+    their `sampler` and EXTEND the key with the sequence-bucket fields
+    the runner's `prepare_hydrated` injected (`_prompt_bucket`,
+    `_decode_bucket`) — a 9-tuple. Tasks without those fields keep
+    producing the historic 7-tuple byte for byte, so persisted cost
+    rows and legacy keys keep meaning what they meant."""
+    sched = hydrated.get("scheduler")
+    if sched is None:
+        sched = hydrated.get("sampler")
+    key = (model_id, hydrated.get("width"), hydrated.get("height"),
+           hydrated.get("num_inference_steps"), sched,
+           hydrated.get("num_frames"), mode)
+    pb = hydrated.get("_prompt_bucket")
+    db = hydrated.get("_decode_bucket")
+    if pb is None and db is None:
+        return key
+    return key + (pb, db)
 
 
 def bucket_mode(key: tuple) -> str:
@@ -426,3 +441,112 @@ class SD15Runner:
             int(hydrated.get("width", 512)),
             int(hydrated.get("num_inference_steps", 20)),
             hydrated.get("scheduler", "DDIM"))
+
+
+def count_decode_stall(n: int = 1) -> None:
+    """Bump `arbius_decode_stalls_total` — a text solve whose decode
+    produced ZERO output bytes (immediate eos / nothing representable).
+    Observation only: the empty artifact is still the committed bytes,
+    never retried or mutated. One registration site shared by the
+    production finalize path and the simnet fault plane so the metric
+    carries one help string (docs/observability.md; the healthwatch
+    `decode_stall` rule watches this counter)."""
+    from arbius_tpu.obs import current_obs
+
+    obs = current_obs()
+    if obs is not None:
+        obs.registry.counter(
+            "arbius_decode_stalls_total",
+            "text solves whose decode produced zero output bytes",
+        ).inc(n)
+
+
+class TextGenRunner:
+    """textgen-template runner: decoder-only LM → deterministic UTF-8.
+
+    Template variables (templates/textgen.json): prompt,
+    max_new_tokens, sampler (enum); output out-1.txt. The sequence
+    buckets (docs/text-serving.md) ride the hydrated input as
+    `_prompt_bucket`/`_decode_bucket` — injected by `prepare_hydrated`
+    at intake so the node's bucket_key, cost tags, and the packer all
+    see them without re-deriving the policy.
+    """
+
+    def __init__(self, pipeline, params, out_name: str = "out-1.txt"):
+        self.pipeline = pipeline
+        self.params = params
+        self.out_name = out_name
+
+    def prepare_hydrated(self, hydrated: dict) -> dict:
+        """Stamp the family's sequence-bucket fields onto the hydrated
+        input (node/_process_task calls this right after hydration).
+        Pure function of (input, pipeline config): every honest node
+        with the same fleet-wide bucket edges stamps the same fields."""
+        h = dict(hydrated)
+        h["_prompt_bucket"] = self.pipeline.prompt_bucket_for(
+            h.get("prompt", ""))
+        h["_decode_bucket"] = self.pipeline.decode_bucket_for(
+            int(h.get("max_new_tokens") or 16))
+        return h
+
+    def _buckets_of(self, hydrated: dict) -> tuple[int, int]:
+        pb = hydrated.get("_prompt_bucket")
+        db = hydrated.get("_decode_bucket")
+        if pb is None:
+            pb = self.pipeline.prompt_bucket_for(hydrated.get("prompt", ""))
+        if db is None:
+            db = self.pipeline.decode_bucket_for(
+                int(hydrated.get("max_new_tokens") or 16))
+        return int(pb), int(db)
+
+    def __call__(self, hydrated: dict, seed: int) -> dict:
+        return self.run_batch([(hydrated, seed)])[0]
+
+    def run_batch(self, items: list[tuple[dict, int]]) -> list[dict]:
+        return self.finalize(self.dispatch(items), len(items))
+
+    def dispatch(self, items: list[tuple[dict, int]]):
+        """Queue the bucket's decode loop and return WITHOUT waiting
+        (JAX async dispatch — see SD15Runner.dispatch). The per-item
+        requested budgets ride along to finalize: the program always
+        runs the full decode bucket and the host truncates, which is
+        byte-sound because generation is causally prefix-stable
+        (docs/text-serving.md)."""
+        first = items[0][0]
+        pb, db = self._buckets_of(first)
+        tokens = self.pipeline.generate(
+            self.params,
+            prompts=[str(h.get("prompt", "")) for h, _ in items],
+            seeds=[s for _, s in items],
+            prompt_bucket=pb, decode_bucket=db,
+            sampler=first.get("sampler") or "greedy",
+            as_device=True,
+        )
+        return tokens, [int(h.get("max_new_tokens") or 16)
+                        for h, _ in items]
+
+    def finalize(self, dev, n_real: int) -> list[dict]:
+        from arbius_tpu.models.textgen import tokens_to_bytes
+        from arbius_tpu.parallel.meshsolve import gather_canonical
+
+        tokens, budgets = dev
+        with span("solve.encode", n=n_real, codec="text"):
+            tokens = gather_canonical(tokens)
+            out = []
+            stalls = 0
+            for i in range(n_real):
+                text = tokens_to_bytes(tokens[i], budgets[i],
+                                       self.pipeline.EOS_ID)
+                if not text:
+                    stalls += 1
+                out.append({self.out_name: text})
+            if stalls:
+                count_decode_stall(stalls)
+            return out
+
+    def cache_tag(self, hydrated: dict, batch: int) -> str:
+        """Scheduler's cross-life disk-warm join key — bucket policy
+        identical to `dispatch` (docs/compile-cache.md)."""
+        pb, db = self._buckets_of(hydrated)
+        return self.pipeline.bucket_tag(
+            batch, pb, db, hydrated.get("sampler") or "greedy")

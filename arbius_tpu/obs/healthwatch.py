@@ -229,6 +229,12 @@ def default_catalog(cfg) -> tuple[AlertRule, ...]:
                           "exceeds the declared slo.time_to_commit_p99 "
                           "(docs/fleetscope.md)",
                   for_ticks=ft("slo_time_to_commit", cfg.for_ticks)),
+        AlertRule(name="decode_stall", signal="decode_stall",
+                  summary="text solves this tick whose decode loop "
+                          "produced zero output bytes (eos at step 0) "
+                          "— a degenerate prompt flood or broken "
+                          "weights (docs/text-serving.md)",
+                  for_ticks=ft("decode_stall", 1)),
     )
 
 
@@ -241,7 +247,7 @@ RULE_NAMES = (
     "chain_replay", "crash_recovered", "contention", "invalid_inputs",
     "pipeline_stall", "unprofitable_streak", "aot_reject_storm",
     "perf_drift", "steal_surge", "lease_starvation", "slo_queue_wait",
-    "slo_time_to_commit",
+    "slo_time_to_commit", "decode_stall",
 )
 
 
@@ -425,6 +431,11 @@ class HealthWatch:
         out["starved"] = (bool(getattr(feed, "starved", False)),
                           "pull had room but acquired nothing while "
                           "leases were pending")
+
+        stalled = d("decode_stalls",
+                    self._sum("arbius_decode_stalls_total"))
+        out["decode_stall"] = (stalled > 0,
+                               f"{int(stalled)} zero-byte decode(s)")
 
         slo = self.slo
         qw = self._hist_pct("arbius_fleet_queue_wait_seconds", 0.95)

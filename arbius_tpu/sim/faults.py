@@ -103,6 +103,7 @@ class FaultPlane:
         self._rng_events = root.stream("events")
         self._rng_pin = root.stream("pin")
         self._rng_runner = root.stream("runner")
+        self._rng_decode = root.stream("decode")
         self.armed = False           # faults suppressed until the harness arms
         self.fault_counts: dict[str, int] = {}
         self.audit: list[AuditRecord] = []
@@ -217,6 +218,21 @@ class FaultPlane:
         if self._rng_runner.chance(self.spec.runner_crash_rate):
             self.count("runner_crash")
             raise SimRunnerError("sim: runner crashed mid-batch")
+
+    def decode_gate(self) -> None:
+        """Text-family decode stall (docs/text-serving.md): the solve
+        "decoded zero output bytes" — surfaced through the SAME
+        production counter the real TextGenRunner.finalize bumps, so
+        the healthwatch decode_stall rule sees sim and production
+        stalls identically. Observation-only: output bytes are NEVER
+        touched (the sim's determinism anchor holds)."""
+        if not self.armed:
+            return
+        if self._rng_decode.chance(self.spec.decode_stall_rate):
+            from arbius_tpu.node.solver import count_decode_stall
+
+            self.count("decode_stall")
+            count_decode_stall()
 
 
 class FaultTransport:
@@ -386,3 +402,54 @@ class FaultyRunner:
             sort_keys=True).encode()
         blob = hashlib.sha256(canon + seed.to_bytes(8, "big")).digest()
         return {self.out_name: b"\x89PNG" + blob}
+
+
+class FaultyTextRunner(FaultyRunner):
+    """Text-family hash-fake (docs/text-serving.md): output bytes are a
+    pure hash stream of (hydrated-minus-seed, seed) truncated to the
+    task's decode budget — so solve cost and output size track
+    `max_new_tokens` the way a real decode loop's do, while staying
+    jax-free. Mirrors the production TextGenRunner's intake hook
+    (`prepare_hydrated` stamps the sequence buckets) so costsched packs
+    real 9-tuple sequence buckets in simnet. Decode-stall faults are
+    counted and surfaced through the production stall counter but NEVER
+    touch the bytes (the sim's determinism anchor)."""
+
+    # the production defaults (node/config.py TextgenConfig) — simnet
+    # buckets must look like a shipped node's
+    PROMPT_EDGES = (32, 64)
+    DECODE_EDGES = (16, 32)
+
+    def __init__(self, plane: FaultPlane, out_name: str = "out-1.txt"):
+        super().__init__(plane, out_name)
+
+    def prepare_hydrated(self, hydrated: dict) -> dict:
+        h = dict(hydrated)
+        need = len(str(h.get("prompt", "")).encode("utf-8")) + 2
+        h["_prompt_bucket"] = next(
+            (e for e in self.PROMPT_EDGES if e >= need),
+            self.PROMPT_EDGES[-1])
+        budget = int(h.get("max_new_tokens") or 16)
+        h["_decode_bucket"] = next(
+            (e for e in self.DECODE_EDGES if e >= max(1, budget)),
+            self.DECODE_EDGES[-1])
+        return h
+
+    def __call__(self, hydrated: dict, seed: int) -> dict:
+        import hashlib
+        import json
+
+        self.plane.decode_gate()
+        self.plane.runner_gate()
+        canon = json.dumps(
+            {k: v for k, v in hydrated.items() if k != "seed"},
+            sort_keys=True).encode()
+        budget = int(hydrated.get("max_new_tokens") or 16)
+        stream = b""
+        counter = 0
+        while len(stream) < budget:
+            stream += hashlib.sha256(
+                canon + seed.to_bytes(8, "big")
+                + counter.to_bytes(4, "big")).digest()
+            counter += 1
+        return {self.out_name: stream[:budget]}

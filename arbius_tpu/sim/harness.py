@@ -66,6 +66,7 @@ from arbius_tpu.sim.faults import (
     FaultPlane,
     FaultTransport,
     FaultyRunner,
+    FaultyTextRunner,
     SimCrash,
     SimPinner,
 )
@@ -300,7 +301,8 @@ class SimHarness:
         chain = AuditedRpcChain(client, self.dev.token_address, self.plane)
         cfg = MiningConfig(
             db_path=":memory:",  # unused: db object injected below
-            models=tuple(ModelConfig(id=mid, template="anythingv3")
+            models=tuple(ModelConfig(id=mid,
+                                     template=self.scenario.template)
                          for mid in self.model_ids),
             # costsched packer (docs/scheduler.md) when the scenario
             # says so: bucket order becomes the scheduler's choice and
@@ -340,12 +342,16 @@ class SimHarness:
             runner = ShardedImageProbe(mesh=self.mesh,
                                        gate=self.plane.runner_gate,
                                        mode=self.precision)
+        elif self.scenario.template == "textgen":
+            # text-family scenarios (docs/text-serving.md): the
+            # token-progress hash-fake with the decode-stall edge
+            runner = FaultyTextRunner(self.plane)
         else:
             runner = FaultyRunner(self.plane)
         registry = ModelRegistry()
         for mid in self.model_ids:
             registry.register(RegisteredModel(
-                id=mid, template=load_template("anythingv3"),
+                id=mid, template=load_template(self.scenario.template),
                 runner=runner))
         db = NodeDB(self.db_path)
         node = self.node_cls(chain, cfg, registry, db=db, store=None,
@@ -385,6 +391,14 @@ class SimHarness:
             # undecodable JSON: hydration must fail and the node must
             # remember the task as invalid (contestation evidence)
             return b'{"prompt": broken'
+        if self.scenario.template == "textgen":
+            # text workload (docs/text-serving.md): mixed decode
+            # budgets land in different decode buckets, alternating
+            # samplers split the greedy/top_k determinism classes
+            obj = {"prompt": f"simnet text {i} {self._rng_work.u64():x}",
+                   "max_new_tokens": (8, 16, 24)[i % 3],
+                   "sampler": "top_k" if i % 2 else "greedy"}
+            return json.dumps(obj, sort_keys=True).encode()
         obj = {"prompt": f"simnet task {i} {self._rng_work.u64():x}",
                "negative_prompt": ""}
         if i % self.scenario.families:

@@ -646,6 +646,10 @@ FAULT_ALERTS: dict[str, tuple[str, ...]] = {
     # making the node re-poll (and honestly re-observe) the range —
     # so chain faults may legitimately surface as observed replays
     "crash": ("crash_recovered",),
+    # a simulated zero-byte decode bumps the SAME production counter
+    # the real TextGenRunner.finalize does (docs/text-serving.md), so
+    # the decode_stall rule must see it
+    "decode_stall": ("decode_stall",),
     # latency / runner_slow / pin_stall / coordinator_crash: timing or
     # out-of-scope — no required alert (documented, not forgotten)
 }

@@ -41,6 +41,8 @@ class FaultSpec:
     # -- runner edge (FaultyRunner) -------------------------------------
     runner_slow_seconds: int = 0      # virtual seconds per solve, 0..max
     runner_crash_rate: float = 0.0    # runner raises mid-batch
+    # -- decode edge (FaultyTextRunner, docs/text-serving.md) -----------
+    decode_stall_rate: float = 0.0    # text solve decodes zero bytes
     # -- process crash ---------------------------------------------------
     crash_after_commit: int | None = None  # kill node after Nth commit lands
 
@@ -83,6 +85,7 @@ class Scenario:
     max_rounds: int = 600          # liveness bound (SIM108 if exceeded)
     burst: int = 1                 # tasks submitted per round (flood > 1)
     families: int = 1              # registered model families to mix
+    template: str = "anythingv3"   # task template the workload speaks
     sched: bool = False            # costsched packer on (docs/scheduler.md)
     fleet: FleetSpec | None = None  # multi-node fleet run (docs/fleet.md)
     faults: FaultSpec = field(default_factory=FaultSpec)
@@ -141,6 +144,17 @@ SCENARIOS: dict[str, Scenario] = {s.name: s for s in (
                     "stability) must hold regardless",
         tasks=16, burst=4, families=2, sched=True, strict=True,
         faults=FaultSpec(latency_max=3, runner_slow_seconds=2)),
+    Scenario(
+        name="text-stream",
+        description="text-generation flood (docs/text-serving.md): "
+                    "token-progress solve times under the fault plane, "
+                    "mixed decode budgets and samplers, costsched "
+                    "packing sequence buckets — decode stalls must "
+                    "surface through healthwatch (SIM113) and every "
+                    "SIM1xx invariant must hold",
+        tasks=12, burst=3, strict=True, sched=True, template="textgen",
+        faults=FaultSpec(decode_stall_rate=0.35, runner_slow_seconds=2,
+                         latency_max=3)),
     Scenario(
         name="fleet-race",
         description="two miners race one coordinator-owned event "

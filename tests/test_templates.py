@@ -16,7 +16,8 @@ from arbius_tpu.templates import (
 def test_all_reference_templates_parse():
     names = template_names()
     assert names == sorted(
-        ["anythingv3", "kandinsky2", "zeroscopev2xl", "damo", "robust_video_matting"])
+        ["anythingv3", "kandinsky2", "zeroscopev2xl", "damo",
+         "robust_video_matting", "textgen"])
     for n in names:
         t = load_template(n)
         assert t.title

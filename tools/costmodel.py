@@ -30,14 +30,26 @@ from _common import EXIT_CLEAN, EXIT_USAGE, kv_table, make_parser
 
 from arbius_tpu.node.costmodel import CostModel  # noqa: E402 (_common fixes path)
 
+# render bound for unbounded bucket spaces (docs/text-serving.md): a
+# sequence-bucketed family can accrue (prompt × decode × sampler) rows
+# without limit, and an operator's terminal is not where to page them —
+# the table caps here and says exactly how much it dropped
+RENDER_CAP = 64
+
 
 def render_rows(rows: list[dict]) -> str:
-    """Fixed-format deterministic table, one line per fitted row. Rows
-    that joined a perf card (docs/perfscope.md) grow the static-fact
-    columns; card-less snapshots render the historic table byte for
-    byte (the tier-1 fixtures pin that)."""
+    """Fixed-format deterministic table, one line per fitted row, capped
+    at RENDER_CAP rows (an explicit trailer counts the omitted ones —
+    silent truncation would read as "that's everything"). Rows that
+    joined a perf card (docs/perfscope.md) grow the static-fact
+    columns; card-less snapshots under the cap render the historic
+    table byte for byte (the tier-1 fixtures pin that)."""
     if not rows:
         return "(no fitted rows)"
+    omitted = 0
+    if len(rows) > RENDER_CAP:
+        omitted = len(rows) - RENDER_CAP
+        rows = rows[:RENDER_CAP]
     head = {"model": "model", "bucket": "bucket", "layout": "layout",
             "mode": "mode", "chip_seconds": "chip_seconds",
             "samples": "samples", "updated": "updated",
@@ -62,6 +74,8 @@ def render_rows(rows: list[dict]) -> str:
     lines = ["  ".join(head[c].ljust(widths[c]) for c in cols)]
     for r in rows:
         lines.append("  ".join(cell(r, c).ljust(widths[c]) for c in cols))
+    if omitted:
+        lines.append(f"({omitted} more buckets)")
     return "\n".join(ln.rstrip() for ln in lines)
 
 
